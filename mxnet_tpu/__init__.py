@@ -1,0 +1,87 @@
+"""mxnet_tpu: a TPU-native deep learning framework with the capabilities of
+pre-Gluon MXNet v0.9 (reference at /root/reference), built on JAX/XLA/Pallas.
+
+User-facing surfaces mirror the reference python package
+(``python/mxnet/__init__.py``): ``mx.nd``, ``mx.sym``, ``mx.mod.Module``,
+``mx.io``, ``mx.kv``, ``mx.optimizer``, ``mx.metric``, ``mx.init``,
+``mx.rnn`` — but the execution substrate is XLA: whole graphs compile to
+single HLO computations, distribution is jax.sharding over a device Mesh,
+and gradient sync is an ICI all-reduce instead of a parameter server.
+"""
+from . import base
+from .base import (Context, MXNetError, cpu, gpu, tpu, current_context)
+from . import name
+from . import attribute
+from .attribute import AttrScope
+from . import op
+from .op import registry as _registry
+from . import ndarray
+from . import ndarray as nd
+from . import autograd
+from . import random
+from . import symbol
+from . import symbol as sym
+from .symbol import Variable, Group
+from . import executor
+from .executor import Executor
+
+__version__ = "0.1.0"
+
+
+def _populate_namespaces():
+    """Attach generated op front-ends to mx.nd and mx.sym (the analog of the
+    reference's ``_init_ndarray_module``/``_init_symbol_module`` which
+    reflect over MXListFunctions)."""
+    from .op.invoke import make_ndarray_function
+    from .symbol import make_symbol_function
+
+    for op_name in list(_registry._REGISTRY):
+        op_obj = _registry._REGISTRY[op_name]
+        if not hasattr(ndarray, op_name):
+            setattr(ndarray, op_name, make_ndarray_function(op_obj))
+        if not hasattr(symbol, op_name):
+            setattr(symbol, op_name, make_symbol_function(op_obj))
+    for alias_name, target in _registry._ALIASES.items():
+        op_obj = _registry._REGISTRY[target]
+        if not hasattr(ndarray, alias_name):
+            setattr(ndarray, alias_name, make_ndarray_function(op_obj))
+        if not hasattr(symbol, alias_name):
+            setattr(symbol, alias_name, make_symbol_function(op_obj))
+
+
+_populate_namespaces()
+
+# sampling front-ends re-exported on mx.random (reference mxnet/random.py)
+for _sampler in ("uniform", "normal"):
+    setattr(random, _sampler, getattr(ndarray, _sampler))
+
+try:
+    from . import initializer
+    from . import initializer as init
+    from . import optimizer
+    from .optimizer import Optimizer
+    from . import lr_scheduler
+    from . import metric
+    from . import callback
+    from . import io
+    from . import recordio
+    from . import kvstore
+    from . import kvstore as kv
+    from . import module
+    from . import module as mod
+    from .module import Module
+    from . import monitor
+    from .monitor import Monitor
+    from . import test_utils
+    from . import visualization
+    from . import visualization as viz
+    from . import rnn
+    from . import model
+    from .model import FeedForward
+    from .executor_manager import DataParallelExecutorGroup  # noqa: F401
+    from . import profiler
+    from . import operator
+    from .operator import CustomOp, CustomOpProp
+    from . import parallel
+except ImportError:  # pragma: no cover - bootstrap guard, removed once complete
+    pass

@@ -1,0 +1,190 @@
+"""Imperative autograd — tape + JAX vjp replay.
+
+Reference: ``AutogradRuntime`` (``src/ndarray/autograd.h:51-98``) records
+each imperative op as an AGNode, then builds an NNVM graph and replays it
+through a GraphExecutor.  Here the tape stores (op, params, captured input
+values); ``backward`` walks the tape in reverse calling ``jax.vjp`` per
+node — each vjp of a cached jitted body stays compiled, so replay is a
+sequence of XLA executions, not Python math.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+_STATE = threading.local()
+
+
+def _state():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+        _STATE.tape = None
+    return _STATE
+
+
+def is_recording():
+    return _state().recording
+
+
+def is_training():
+    return _state().training
+
+
+def set_recording(is_rec):
+    prev = _state().recording
+    _STATE.recording = is_rec
+    return prev
+
+
+def set_training(train_mode):
+    prev = _state().training
+    _STATE.training = train_mode
+    return prev
+
+
+class _RecordingScope:
+    def __init__(self, record, train):
+        self._record = record
+        self._train = train
+
+    def __enter__(self):
+        st = _state()
+        self._prev = (st.recording, st.training, st.tape)
+        st.recording = self._record
+        st.training = self._train
+        if self._record and st.tape is None:
+            st.tape = Tape()
+        return self
+
+    def __exit__(self, *args):
+        st = _state()
+        st.recording, st.training, st.tape = self._prev
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — start recording (ref c_api.h:534)."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(_state().recording, True)
+
+
+def predict_mode():
+    return _RecordingScope(_state().recording, False)
+
+
+class TapeNode:
+    __slots__ = ("op", "params", "ctx", "inputs", "in_vals", "outputs")
+
+    def __init__(self, op, params, ctx, inputs, in_vals, outputs):
+        self.op = op
+        self.params = params
+        self.ctx = ctx
+        self.inputs = inputs      # list of NDArray (weak identity by id)
+        self.in_vals = in_vals    # captured jax values at execution time
+        self.outputs = outputs    # list of NDArray
+
+
+class Tape:
+    def __init__(self):
+        self.nodes: List[TapeNode] = []
+        self.marked: Dict[int, tuple] = {}  # id(NDArray) -> (array, grad, req)
+
+    def record(self, op, params, ctx, inputs, outputs):
+        self.nodes.append(
+            TapeNode(op, params, ctx, inputs, [a.data for a in inputs], outputs))
+
+    def mark(self, arr, grad, req):
+        self.marked[id(arr)] = (arr, grad, req)
+
+
+def get_tape() -> Tape:
+    st = _state()
+    if st.tape is None:
+        st.tape = Tape()
+    return st.tape
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers (reference ``MXAutogradMarkVariables``)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    tape = get_tape()
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var.grad = grad
+        tape.mark(var, grad, req)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t marked variables
+    (reference ``AutogradRuntime::ComputeGradient``, autograd.cc:132-165)."""
+    from .ndarray import NDArray
+
+    st = _state()
+    tape = st.tape
+    if tape is None or not tape.nodes:
+        raise MXNetError("no computation recorded; use autograd.record()")
+
+    # accumulated cotangent per array id
+    grads: Dict[int, jnp.ndarray] = {}
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    for h, hg in zip(heads, head_grads):
+        g = hg.data if isinstance(hg, NDArray) else (
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg))
+        grads[id(h)] = g
+
+    for node in reversed(tape.nodes):
+        out_ids = [id(o) for o in node.outputs]
+        if not any(i in grads for i in out_ids):
+            continue
+        op, params, ctx = node.op, node.params, node.ctx
+
+        def pure(*xs, _op=op, _params=params, _ctx=ctx):
+            outs, _aux = _op.apply(_params, _ctx, *xs)
+            return tuple(outs)
+
+        outs, vjp_fn = jax.vjp(pure, *node.in_vals)
+        cotangents = tuple(
+            grads.get(i, jnp.zeros(o.shape, o.dtype))
+            for i, o in zip(out_ids, outs))
+        in_grads = vjp_fn(cotangents)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            key = id(inp)
+            grads[key] = grads[key] + g if key in grads else g
+
+    # write into marked variable grad buffers
+    for key, (arr, grad_buf, req) in tape.marked.items():
+        if req == "null" or key not in grads:
+            continue
+        if req == "add":
+            grad_buf._set_data(grad_buf.data + grads[key])
+        else:
+            grad_buf._set_data(grads[key].astype(grad_buf.dtype))
+    if not retain_graph:
+        tape.nodes = []
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional-style gradient: returns new NDArrays instead of writing
+    into attached buffers."""
+    from .ndarray import NDArray, zeros
+    gbufs = [zeros(v.shape, v.context, v.dtype) for v in variables]
+    mark_variables(variables, gbufs, "write")
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    return gbufs

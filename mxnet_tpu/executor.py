@@ -1,0 +1,303 @@
+"""Graph executor: Symbol -> one jitted XLA computation.
+
+Reference: ``GraphExecutor`` (``src/executor/graph_executor.cc:372-446``)
+runs a 10-stage pass pipeline then pushes one engine op per node.  Here
+``bind`` builds a single pure function that walks the graph (a Python trace,
+run once), jits it, and:
+
+  * ``forward(is_train=True)`` calls ``jax.vjp`` on the jitted function —
+    the forward executes as ONE compiled XLA program and the residuals are
+    kept for backward (no recompute; the linearize/transpose caches make the
+    per-step Python overhead bounded).
+  * ``backward(out_grads)`` calls the pullback — one more compiled program.
+  * memory planning (``PlanMemory``), in-place detection
+    (``DetectInplaceAddTo``) and op fusion (bulk segments) are all XLA's
+    job; none of the reference's passes exist here because the compiler
+    subsumes them.
+
+PRNG for stochastic nodes (Dropout): a key is folded per forward call and
+per node — the functional replacement of ``ResourceRequest::kRandom``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, _dtype, current_context
+from .ndarray import NDArray, zeros
+from .op.registry import OpContext
+from .symbol import Symbol, _topo
+
+__all__ = ["Executor", "bind", "simple_bind"]
+
+
+class _GraphProgram:
+    """The compiled form of a Symbol: pure fn + metadata."""
+
+    def __init__(self, sym: Symbol):
+        self.sym = sym
+        self.nodes = _topo([e[0] for e in sym._outputs])
+        self.arg_names = sym.list_arguments()
+        self.aux_names = sym.list_auxiliary_states()
+        self.output_entries = list(sym._outputs)
+        self._arg_index = {n: i for i, n in enumerate(self.arg_names)}
+        # aux slots per node
+        self._aux_index = {n: i for i, n in enumerate(self.aux_names)}
+        self.has_rng = any((not n.is_variable) and n.op.uses_rng
+                           for n in self.nodes)
+        self._jitted = {}
+
+    # ------------------------------------------------------------------
+    def _eval(self, arg_vals, aux_vals, rng_key, is_train, monitor=None):
+        env = {}
+        aux_out = list(aux_vals)
+        for n in self.nodes:
+            if n.is_variable:
+                env[(id(n), 0)] = arg_vals[self._arg_index[n.name]]
+                continue
+            in_vals = [env[(id(c), i)] for c, i in n.inputs]
+            aux_names = n.aux_names()
+            aux_slots = [self._aux_index["%s_%s" % (n.name, a)]
+                         for a in aux_names]
+            node_aux = [aux_vals[s] for s in aux_slots]
+            if aux_names:
+                node_aux = [jax.lax.stop_gradient(v) for v in node_aux]
+            rng = None
+            if n.op.uses_rng:
+                rng = jax.random.fold_in(rng_key, len(env))
+            ctx = OpContext(is_train=is_train, rng=rng)
+            outs, aux_updates = n.op.apply(n.params, ctx, *(in_vals + node_aux))
+            for i, v in enumerate(outs):
+                env[(id(n), i)] = v
+                if monitor is not None:
+                    monitor("%s_%s" % (n.name, n.op.list_outputs(n.params)[i]), v)
+            for s, v in zip(aux_slots, aux_updates):
+                aux_out[s] = v
+        outputs = tuple(env[(id(nd), i)] for nd, i in self.output_entries)
+        return outputs, tuple(aux_out)
+
+    def jitted(self, is_train):
+        if is_train not in self._jitted:
+            def fn(arg_vals, aux_vals, rng_key):
+                return self._eval(list(arg_vals), list(aux_vals), rng_key,
+                                  is_train)
+            self._jitted[is_train] = jax.jit(fn)
+        return self._jitted[is_train]
+
+
+class Executor:
+    """Bound executor (reference ``include/mxnet/executor.h:34-102``)."""
+
+    def __init__(self, sym: Symbol, ctx, args: Dict[str, NDArray],
+                 args_grad: Optional[Dict[str, NDArray]],
+                 grad_req, aux_states: Dict[str, NDArray],
+                 group2ctx=None):
+        self._symbol = sym
+        self._ctx = ctx or current_context()
+        self._prog = _GraphProgram(sym)
+        self.arg_dict = args
+        self.grad_dict = args_grad or {}
+        self.aux_dict = aux_states
+        self.arg_arrays = [args[n] for n in self._prog.arg_names]
+        self.grad_arrays = [self.grad_dict.get(n) for n in self._prog.arg_names]
+        self.aux_arrays = [aux_states[n] for n in self._prog.aux_names]
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._prog.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._prog.arg_names, grad_req))
+        self.grad_req = grad_req
+        self._group2ctx = group2ctx or {}
+        self._outputs: List[NDArray] = []
+        self._vjp = None
+        self._monitor = None
+        self._rng_counter = 0
+
+    @property
+    def outputs(self):
+        return self._outputs
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self._outputs))
+
+    # ------------------------------------------------------------------
+    def _next_key(self):
+        from . import random as _random
+        if self._prog.has_rng:
+            return _random.next_key()
+        return jax.random.key(0)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k not in self.arg_dict:
+                raise MXNetError("unknown argument %s" % k)
+            if isinstance(v, NDArray):
+                self.arg_dict[k]._set_data(
+                    v.data.astype(self.arg_dict[k].dtype))
+            else:
+                self.arg_dict[k]._sync_copyfrom(v)
+        arg_vals = tuple(a.data for a in self.arg_arrays)
+        aux_vals = tuple(a.data for a in self.aux_arrays)
+        key = self._next_key()
+
+        if self._monitor is not None:
+            def cb(name, val):
+                self._monitor(name, NDArray(val))
+            outs, new_aux = self._prog._eval(
+                list(arg_vals), list(aux_vals), key, is_train, monitor=cb)
+            self._vjp = None
+        elif is_train:
+            fn = self._prog.jitted(True)
+            (outs, new_aux), vjp = jax.vjp(
+                lambda a, x: fn(a, x, key), arg_vals, aux_vals)
+            self._vjp = vjp
+        else:
+            fn = self._prog.jitted(False)
+            outs, new_aux = fn(arg_vals, aux_vals, key)
+            self._vjp = None
+        for arr, v in zip(self.aux_arrays, new_aux):
+            arr._set_data(v)
+        self._outputs = [NDArray(o) for o in outs]
+        return self._outputs
+
+    def backward(self, out_grads=None):
+        if self._vjp is None:
+            raise MXNetError("run forward(is_train=True) before backward")
+        if out_grads is None:
+            out_grads = []
+        elif isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        cotangents = []
+        for i, o in enumerate(self._outputs):
+            if i < len(out_grads) and out_grads[i] is not None:
+                g = out_grads[i]
+                cotangents.append(g.data if isinstance(g, NDArray)
+                                  else jnp.asarray(g))
+            else:
+                cotangents.append(jnp.ones(o.shape, o.dtype))
+        aux_cot = tuple(jnp.zeros(a.shape, a.dtype) for a in self.aux_arrays)
+        arg_grads, _aux_grads = self._vjp((tuple(cotangents), aux_cot))
+        for name, arr, g in zip(self._prog.arg_names, self.grad_arrays,
+                                arg_grads):
+            req = self.grad_req.get(name, "null")
+            if arr is None or req == "null":
+                continue
+            if req == "add":
+                arr._set_data(arr.data + g.astype(arr.dtype))
+            else:
+                arr._set_data(g.astype(arr.dtype))
+        return [NDArray(g) for g in arg_grads]
+
+    # ------------------------------------------------------------------
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes (jit recompiles per shape — the
+        TPU analog of the reference's shared-memory rebind)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shape in zip(self._prog.arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(shape):
+                new_args[name] = old
+            else:
+                new_args[name] = zeros(shape, self._ctx, old.dtype)
+        new_aux = {}
+        for name, shape in zip(self._prog.aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(shape) \
+                else zeros(shape, self._ctx, old.dtype)
+        grads = None
+        if self.grad_dict:
+            grads = {n: zeros(new_args[n].shape, self._ctx, new_args[n].dtype)
+                     for n in self.grad_dict}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self.grad_req, new_aux, self._group2ctx)
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(
+                    arr.data.astype(self.arg_dict[name].dtype))
+            elif not allow_extra_params:
+                raise MXNetError("unknown argument %s" % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._set_data(
+                        arr.data.astype(self.aux_dict[name].dtype))
+                elif not allow_extra_params:
+                    raise MXNetError("unknown aux state %s" % name)
+
+    def install_monitor(self, callback):
+        """Per-op output tap (reference ``graph_executor.cc:757-778``;
+        disables whole-graph fusion exactly like the reference disables
+        bulk exec)."""
+        self._monitor = callback
+
+    def debug_str(self):
+        lines = ["Symbol outputs: %s" % ", ".join(self._symbol.list_outputs())]
+        for n in self._prog.nodes:
+            if n.is_variable:
+                lines.append("Variable:%s" % n.name)
+            else:
+                lines.append("Op:%s, Name=%s" % (n.op.name, n.name))
+        return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+def bind(sym, ctx, args, args_grad=None, grad_req="write", aux_states=None,
+         group2ctx=None, shared_exec=None):
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    args = _to_dict(args, arg_names, "args")
+    if args_grad is not None:
+        args_grad = _to_dict(args_grad, arg_names, "args_grad", allow_partial=True)
+    aux_states = _to_dict(aux_states or [], aux_names, "aux_states",
+                          allow_missing=(len(aux_names) == 0))
+    if len(aux_names) and not aux_states:
+        raise MXNetError("aux_states required for %s" % aux_names)
+    return Executor(sym, ctx, args, args_grad, grad_req, aux_states,
+                    group2ctx)
+
+
+def simple_bind(sym, ctx=None, grad_req="write", type_dict=None,
+                group2ctx=None, shared_exec=None, **kwargs):
+    ctx = ctx or current_context()
+    arg_shapes, _, aux_shapes = sym.infer_shape(**kwargs)
+    if arg_shapes is None:
+        raise MXNetError("cannot infer shapes from %s" % kwargs)
+    type_dict = type_dict or {}
+    arg_types, _, aux_types = sym.infer_type(**type_dict)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    args = {n: zeros(s, ctx, t or np.float32)
+            for n, s, t in zip(arg_names, arg_shapes, arg_types)}
+    if isinstance(grad_req, dict):
+        reqs = grad_req
+    elif isinstance(grad_req, (list, tuple)):
+        reqs = dict(zip(arg_names, grad_req))
+    else:
+        reqs = {n: grad_req for n in arg_names}
+    args_grad = {n: zeros(s, ctx, t or np.float32)
+                 for n, s, t in zip(arg_names, arg_shapes, arg_types)
+                 if reqs.get(n, "null") != "null"}
+    aux_states = {n: zeros(s, ctx, t or np.float32)
+                  for n, s, t in zip(aux_names, aux_shapes, aux_types)}
+    return Executor(sym, ctx, args, args_grad, grad_req, aux_states, group2ctx)
+
+
+def _to_dict(arrays, names, what, allow_partial=False, allow_missing=False):
+    if isinstance(arrays, dict):
+        missing = [n for n in names if n not in arrays]
+        if missing and not (allow_partial or allow_missing):
+            raise MXNetError("%s missing entries for %s" % (what, missing))
+        return {n: arrays[n] for n in names if n in arrays}
+    arrays = list(arrays)
+    if len(arrays) != len(names) and not allow_missing:
+        raise MXNetError("%s length %d != expected %d (%s)"
+                         % (what, len(arrays), len(names), names))
+    return dict(zip(names, arrays))

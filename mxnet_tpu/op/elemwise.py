@@ -1,0 +1,144 @@
+"""Elementwise / broadcast / scalar op families.
+
+Reference: the mshadow_op functor library (``src/operator/mshadow_op.h``,
+102 structs) expanded through the family macros
+``MXNET_OPERATOR_REGISTER_UNARY/BINARY/_SCALAR/_BROADCAST``
+(``src/operator/tensor/elemwise_*``).  On TPU each op *is* the jnp
+expression; XLA fuses chains of them into single kernels, so there is no
+functor/launcher split to replicate.  Gradients come from JAX autodiff —
+no per-op backward structs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Param, register, alias
+
+_f = jnp  # brevity
+
+
+def _reg_binary(name, fn, aliases=()):
+    register(name, lambda p, c, a, b, _fn=fn: _fn(a, b),
+             input_names=("lhs", "rhs"))
+    for al in aliases:
+        alias(al, name)
+
+
+def _reg_binary_scalar(name, fn):
+    register(name, lambda p, c, a, _fn=fn: _fn(a, jnp.asarray(p["scalar"], a.dtype)
+                                               if np.issubdtype(np.dtype(a.dtype), np.number)
+                                               else p["scalar"]),
+             params_spec=(Param("scalar", float, required=True),))
+
+
+def _reg_unary(name, fn, aliases=()):
+    register(name, lambda p, c, a, _fn=fn: _fn(a))
+    for al in aliases:
+        alias(al, name)
+
+
+# --- binary elementwise + their broadcast_* twins ----------------------
+_BINARY = {
+    "plus": _f.add, "minus": _f.subtract, "mul": _f.multiply,
+    "div": _f.divide, "mod": lambda a, b: _f.mod(a, b),
+    "power": _f.power, "maximum": _f.maximum, "minimum": _f.minimum,
+    "hypot": _f.hypot,
+}
+_CMP = {
+    "equal": lambda a, b: (a == b), "not_equal": lambda a, b: (a != b),
+    "greater": lambda a, b: (a > b), "greater_equal": lambda a, b: (a >= b),
+    "lesser": lambda a, b: (a < b), "lesser_equal": lambda a, b: (a <= b),
+}
+
+for _name, _fn in _BINARY.items():
+    _reg_binary("_" + _name, _fn)
+    register("broadcast_" + ("add" if _name == "plus" else
+                             "sub" if _name == "minus" else _name),
+             lambda p, c, a, b, _fn=_fn: _fn(a, b), input_names=("lhs", "rhs"))
+    _reg_binary_scalar("_%s_scalar" % _name, _fn)
+
+for _name, _fn in _CMP.items():
+    # comparisons produce float like the reference (mshadow_op.h eq/ne/...)
+    _reg_binary("_" + _name, lambda a, b, _fn=_fn: _fn(a, b).astype(a.dtype))
+    register("broadcast_" + _name,
+             lambda p, c, a, b, _fn=_fn: _fn(a, b).astype(a.dtype),
+             input_names=("lhs", "rhs"))
+    _reg_binary_scalar("_%s_scalar" % _name,
+                       lambda a, s, _fn=_fn: _fn(a, s).astype(a.dtype))
+
+_reg_binary_scalar("_rminus_scalar", lambda a, s: s - a)
+_reg_binary_scalar("_rdiv_scalar", lambda a, s: s / a)
+_reg_binary_scalar("_rmod_scalar", lambda a, s: _f.mod(s, a))
+_reg_binary_scalar("_rpower_scalar", lambda a, s: _f.power(s, a))
+
+alias("elemwise_add", "_plus")
+alias("elemwise_sub", "_minus")
+alias("elemwise_mul", "_mul")
+alias("elemwise_div", "_div")
+alias("_add", "_plus")
+alias("_sub", "_minus")
+alias("_grad_add", "_plus")
+alias("_Plus", "_plus")
+alias("_Minus", "_minus")
+alias("_Mul", "_mul")
+alias("_Div", "_div")
+
+# --- unary math --------------------------------------------------------
+_sigmoid = jax.nn.sigmoid
+_UNARY = {
+    "abs": _f.abs, "sign": _f.sign, "rint": _f.rint, "ceil": _f.ceil,
+    "floor": _f.floor, "trunc": _f.trunc, "fix": _f.trunc,
+    "round": _f.round, "square": _f.square, "sqrt": _f.sqrt,
+    "rsqrt": lambda a: 1.0 / _f.sqrt(a), "cbrt": _f.cbrt,
+    "rcbrt": lambda a: 1.0 / _f.cbrt(a),
+    "exp": _f.exp, "log": _f.log, "log10": _f.log10, "log2": _f.log2,
+    "log1p": _f.log1p, "expm1": _f.expm1,
+    "sin": _f.sin, "cos": _f.cos, "tan": _f.tan,
+    "arcsin": _f.arcsin, "arccos": _f.arccos, "arctan": _f.arctan,
+    "sinh": _f.sinh, "cosh": _f.cosh, "tanh": _f.tanh,
+    "arcsinh": _f.arcsinh, "arccosh": _f.arccosh, "arctanh": _f.arctanh,
+    "degrees": _f.degrees, "radians": _f.radians,
+    "gamma": lambda a: _f.exp(jax.scipy.special.gammaln(a)),
+    "gammaln": jax.scipy.special.gammaln,
+    "negative": _f.negative,
+    "reciprocal": lambda a: 1.0 / a,
+    "sigmoid": _sigmoid,
+    "relu": jax.nn.relu,
+    "softrelu": jax.nn.softplus,
+    "erf": jax.scipy.special.erf,
+}
+for _name, _fn in _UNARY.items():
+    _reg_unary(_name, _fn)
+
+register("identity", lambda p, c, a: a)
+alias("_copy", "identity")
+
+
+@register("clip", params_spec=(Param("a_min", float, required=True),
+                               Param("a_max", float, required=True)))
+def _clip(p, c, a):
+    return _f.clip(a, p["a_min"], p["a_max"])
+
+
+@register("smooth_l1", params_spec=(Param("scalar", float, 1.0),))
+def _smooth_l1(p, c, a):
+    s2 = p["scalar"] ** 2
+    absd = _f.abs(a)
+    return _f.where(absd < 1.0 / s2, 0.5 * s2 * a * a, absd - 0.5 / s2)
+
+
+def _sum_n(p, c, *xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+register("add_n", _sum_n,
+         params_spec=(Param("num_args", int, required=True),),
+         input_names=lambda p: ["arg%d" % i for i in range(p["num_args"])])
+alias("ElementWiseSum", "add_n")
+alias("_sum_n", "add_n")

@@ -1,0 +1,118 @@
+"""Creation + RNG sampling ops.
+
+Reference: ``src/operator/tensor/init_op.*`` and ``sample_op.*`` (samplers
+backed by ``ResourceRequest::kRandom``).  Here samplers take an explicit JAX
+PRNG key from the op context (``uses_rng=True``) — keys are threaded by the
+executor / eager dispatcher, so sampling is deterministic per seed and safe
+under jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .registry import Param, register, alias
+
+
+def _creation_spec():
+    return (Param("shape", "shape", required=True),
+            Param("ctx", str, None),
+            Param("dtype", "dtype", np.dtype(np.float32)))
+
+
+register("_zeros", lambda p, c: jnp.zeros(p["shape"], p["dtype"]),
+         params_spec=_creation_spec(), input_names=())
+register("_ones", lambda p, c: jnp.ones(p["shape"], p["dtype"]),
+         params_spec=_creation_spec(), input_names=())
+register("_full", lambda p, c: jnp.full(p["shape"], p["value"], p["dtype"]),
+         params_spec=_creation_spec() + (Param("value", float, required=True),),
+         input_names=())
+
+
+@register("_arange", params_spec=(Param("start", float, 0.0),
+                                  Param("stop", lambda v: None if v in (None, "None") else float(v), None),
+                                  Param("step", float, 1.0),
+                                  Param("repeat", int, 1),
+                                  Param("ctx", str, None),
+                                  Param("dtype", "dtype", np.dtype(np.float32))),
+          input_names=())
+def _arange_op(p, c):
+    vals = np.arange(p["start"], p["stop"], p["step"], dtype=p["dtype"])
+    if p["repeat"] != 1:
+        vals = np.repeat(vals, p["repeat"])
+    return jnp.asarray(vals)
+
+
+# ----------------------------------------------------------------------
+def _sample_spec(*extra):
+    return extra + (Param("shape", "shape", ()),
+                    Param("ctx", str, None),
+                    Param("dtype", "dtype", np.dtype(np.float32)))
+
+
+def _reg_sampler(name, spec, fn, aliases=()):
+    register(name, fn, params_spec=_sample_spec(*spec), input_names=(),
+             uses_rng=True)
+    for al in aliases:
+        alias(al, name)
+
+
+_reg_sampler(
+    "_sample_uniform", (Param("low", float, 0.0), Param("high", float, 1.0)),
+    lambda p, c: jax.random.uniform(c.rng, p["shape"] or (1,), p["dtype"],
+                                    p["low"], p["high"]),
+    aliases=("uniform", "random_uniform", "_random_uniform"))
+
+_reg_sampler(
+    "_sample_normal", (Param("loc", float, 0.0), Param("scale", float, 1.0)),
+    lambda p, c: p["loc"] + p["scale"] * jax.random.normal(
+        c.rng, p["shape"] or (1,), p["dtype"]),
+    aliases=("normal", "random_normal", "_random_normal"))
+
+_reg_sampler(
+    "_sample_gamma", (Param("alpha", float, 1.0), Param("beta", float, 1.0)),
+    lambda p, c: jax.random.gamma(c.rng, p["alpha"], p["shape"] or (1,),
+                                  p["dtype"]) * p["beta"],
+    aliases=("random_gamma",))
+
+_reg_sampler(
+    "_sample_exponential", (Param("lam", float, 1.0),),
+    lambda p, c: jax.random.exponential(c.rng, p["shape"] or (1,),
+                                        p["dtype"]) / p["lam"],
+    aliases=("random_exponential",))
+
+_reg_sampler(
+    "_sample_poisson", (Param("lam", float, 1.0),),
+    lambda p, c: jax.random.poisson(c.rng, p["lam"], p["shape"] or (1,)
+                                    ).astype(p["dtype"]),
+    aliases=("random_poisson",))
+
+
+def _neg_binomial(p, c):
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+    k, prob = p["k"], p["p"]
+    k1, k2 = jax.random.split(c.rng)
+    lam = jax.random.gamma(k1, k, p["shape"] or (1,)) * ((1.0 - prob) / prob)
+    return jax.random.poisson(k2, lam).astype(p["dtype"])
+
+
+_reg_sampler("_sample_negbinomial",
+             (Param("k", int, 1), Param("p", float, 1.0)),
+             _neg_binomial, aliases=("random_negative_binomial",))
+
+
+def _gen_neg_binomial(p, c):
+    mu, alpha = p["mu"], p["alpha"]
+    k = 1.0 / alpha
+    prob = k / (k + mu)
+    k1, k2 = jax.random.split(c.rng)
+    lam = jax.random.gamma(k1, k, p["shape"] or (1,)) * ((1.0 - prob) / prob)
+    return jax.random.poisson(k2, lam).astype(p["dtype"])
+
+
+_reg_sampler("_sample_gennegbinomial",
+             (Param("mu", float, 1.0), Param("alpha", float, 1.0)),
+             _gen_neg_binomial,
+             aliases=("random_generalized_negative_binomial",))

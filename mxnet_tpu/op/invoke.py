@@ -1,0 +1,93 @@
+"""Imperative op invocation — the TPU analog of ``MXImperativeInvoke``
+(reference ``src/c_api/c_api_ndarray.cc:324-390``).
+
+Where the reference pushes one engine op per call, here each call applies a
+pure JAX function; JAX's dispatch cache plays the role of the engine's cached
+operators and its async dispatch replaces the threaded engine.  When
+autograd is recording, the call is appended to the tape
+(reference ``AutogradRuntime::RecordImperativeFCompute``,
+``src/ndarray/autograd.cc``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .registry import Op, OpContext
+
+
+def invoke(op: Op, inputs: List["NDArray"], kwargs: Dict, out=None,
+           aux_states: Optional[List["NDArray"]] = None):
+    """Apply ``op`` eagerly to NDArray inputs.  Returns list of NDArrays."""
+    from .. import autograd, random as _random
+    from ..ndarray import NDArray
+
+    params = op.parse_params(kwargs)
+    is_train = autograd.is_training()
+    rng = _random.next_key() if op.uses_rng else None
+    ctx = OpContext(is_train=is_train, rng=rng)
+
+    aux_states = aux_states or []
+    in_vals = [a.data for a in inputs] + [a.data for a in aux_states]
+    outs, aux_updates = op.apply(params, ctx, *in_vals)
+
+    if out is not None:
+        out_nd = [out] if isinstance(out, NDArray) else list(out)
+        for o, v in zip(out_nd, outs):
+            o._set_data(v)
+    else:
+        out_nd = [NDArray(v) for v in outs]
+
+    for a, v in zip(aux_states, aux_updates):
+        a._set_data(v)
+
+    if autograd.is_recording():
+        # aux states are part of the replayed op's arity; their grads are
+        # discarded in backward since aux arrays are never marked variables
+        autograd.get_tape().record(op, params, ctx, inputs + aux_states, out_nd)
+    return out_nd
+
+
+def make_ndarray_function(op: Op):
+    """Build the generated ``mx.nd.<op>`` front-end."""
+    from ..ndarray import NDArray
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        name = kwargs.pop("name", None)  # accepted for API parity, unused
+        arrays = [a for a in args if isinstance(a, NDArray)]
+        scalars = [a for a in args if not isinstance(a, NDArray)]
+        if scalars:
+            raise TypeError(
+                "%s: positional args must be NDArrays, use kwargs for params"
+                % op.name)
+        # pull named inputs/aux out of kwargs
+        probe = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, NDArray)}
+        params = op.parse_params(probe)
+        input_names = op.list_inputs(params)
+        aux_names = op.list_aux(params)
+        named_arrays = {k: v for k, v in kwargs.items() if isinstance(v, NDArray)}
+        for k in named_arrays:
+            kwargs.pop(k)
+        ins = []
+        it = iter(arrays)
+        for nm in input_names:
+            if nm in named_arrays:
+                ins.append(named_arrays.pop(nm))
+            else:
+                try:
+                    ins.append(next(it))
+                except StopIteration:
+                    raise TypeError("%s missing input %r" % (op.name, nm))
+        aux = [named_arrays.pop(nm) for nm in aux_names if nm in named_arrays]
+        leftovers = list(it)
+        if leftovers or named_arrays:
+            raise TypeError("%s got extra array arguments" % op.name)
+        res = invoke(op, ins, kwargs, out=out, aux_states=aux)
+        if out is not None:
+            return out
+        return res[0] if len(res) == 1 else res
+
+    fn.__name__ = op.name
+    fn.__doc__ = "Imperative op %s (auto-generated)" % op.name
+    return fn

@@ -1,0 +1,87 @@
+"""Fused optimizer update ops.
+
+Reference: ``src/operator/optimizer_op.cc:18-98`` registers sgd_update,
+sgd_mom_update, adam_update, rmsprop_update, rmspropalex_update as NNVM ops
+so updates run on-device.  Here each is one jnp expression; inside the
+Module's fused train step XLA fuses them with the gradient allreduce, and
+buffer donation makes them true in-place updates in HBM.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import Param, register
+
+
+def _common(*extra):
+    return extra + (
+        Param("lr", float, required=True),
+        Param("wd", float, 0.0),
+        Param("rescale_grad", float, 1.0),
+        Param("clip_gradient", float, -1.0),
+    )
+
+
+def _prep_grad(p, weight, grad):
+    grad = grad * p["rescale_grad"]
+    if p["clip_gradient"] is not None and p["clip_gradient"] > 0:
+        grad = jnp.clip(grad, -p["clip_gradient"], p["clip_gradient"])
+    return grad + p["wd"] * weight
+
+
+@register("sgd_update", params_spec=_common(), input_names=("weight", "grad"))
+def _sgd_update(p, c, weight, grad):
+    return weight - p["lr"] * _prep_grad(p, weight, grad)
+
+
+@register("sgd_mom_update", params_spec=_common(Param("momentum", float, 0.0)),
+          input_names=("weight", "grad", "mom"), num_outputs=2)
+def _sgd_mom_update(p, c, weight, grad, mom):
+    g = _prep_grad(p, weight, grad)
+    mom = p["momentum"] * mom - p["lr"] * g
+    return weight + mom, mom
+
+
+@register("adam_update",
+          params_spec=_common(Param("beta1", float, 0.9),
+                              Param("beta2", float, 0.999),
+                              Param("epsilon", float, 1e-8),
+                              Param("t", int, 1)),
+          input_names=("weight", "grad", "mean", "var"), num_outputs=3)
+def _adam_update(p, c, weight, grad, mean, var):
+    g = _prep_grad(p, weight, grad)
+    mean = p["beta1"] * mean + (1 - p["beta1"]) * g
+    var = p["beta2"] * var + (1 - p["beta2"]) * g * g
+    t = p["t"]
+    coef = p["lr"] * jnp.sqrt(1 - p["beta2"] ** t) / (1 - p["beta1"] ** t)
+    weight = weight - coef * mean / (jnp.sqrt(var) + p["epsilon"])
+    return weight, mean, var
+
+
+@register("rmsprop_update",
+          params_spec=_common(Param("gamma1", float, 0.95),
+                              Param("epsilon", float, 1e-8),
+                              Param("clip_weights", float, -1.0)),
+          input_names=("weight", "grad", "n"), num_outputs=2)
+def _rmsprop_update(p, c, weight, grad, n):
+    g = _prep_grad(p, weight, grad)
+    n = (1 - p["gamma1"]) * g * g + p["gamma1"] * n
+    weight = weight - p["lr"] * g / jnp.sqrt(n + p["epsilon"])
+    if p["clip_weights"] and p["clip_weights"] > 0:
+        weight = jnp.clip(weight, -p["clip_weights"], p["clip_weights"])
+    return weight, n
+
+
+@register("rmspropalex_update",
+          params_spec=_common(Param("gamma1", float, 0.95),
+                              Param("gamma2", float, 0.9),
+                              Param("epsilon", float, 1e-8),
+                              Param("clip_weights", float, -1.0)),
+          input_names=("weight", "grad", "n", "g", "delta"), num_outputs=4)
+def _rmspropalex_update(p, c, weight, grad, n, g_state, delta):
+    g = _prep_grad(p, weight, grad)
+    n = (1 - p["gamma1"]) * g * g + p["gamma1"] * n
+    g_state = (1 - p["gamma1"]) * g + p["gamma1"] * g_state
+    delta = (p["gamma2"] * delta
+             - p["lr"] * g / jnp.sqrt(n - g_state * g_state + p["epsilon"]))
+    return weight + delta, n, g_state, delta

@@ -1,0 +1,461 @@
+"""Shape/layout, reduction, and indexing ops.
+
+Reference: ``src/operator/tensor/matrix_op.cc``, ``broadcast_reduce_op*``,
+``indexing_op.*``, ``ordering_op-inl.h``, ``init_op.*`` (SURVEY §2.2).
+All are thin jnp/lax expressions; XLA handles layout, tiling and fusion —
+the cub/mshadow kernel plumbing has no analog here.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, _dtype
+from .registry import Param, register, alias
+
+
+def _axis_param(name="axis", default=None, required=False):
+    def parse(v):
+        if v is None or v == "None" or v == "()":
+            return None
+        if isinstance(v, str):
+            v = ast.literal_eval(v)
+        if isinstance(v, (list, tuple)):
+            return tuple(int(x) for x in v)
+        return int(v)
+    return Param(name, parse, default, required=required)
+
+
+# ----------------------------------------------------------------------
+# shape / layout
+@register("Reshape", params_spec=(Param("shape", "shape", ()),
+                                  Param("reverse", bool, False),
+                                  Param("target_shape", "shape", None),
+                                  Param("keep_highest", bool, False)),
+          hint="reshape")
+def _reshape(p, c, a):
+    tgt = list(p["shape"] or p["target_shape"] or ())
+    if not tgt:
+        raise MXNetError("Reshape needs shape")
+    src = list(a.shape)
+    # reference special codes (matrix_op.cc): 0 copy, -1 infer, -2 copy-rest,
+    # -3 merge two, -4 split
+    out = []
+    i = 0
+    j = 0
+    while j < len(tgt):
+        d = tgt[j]
+        if d == 0:
+            out.append(src[i]); i += 1
+        elif d == -1:
+            out.append(-1); i += 1
+        elif d == -2:
+            out.extend(src[i:]); i = len(src)
+        elif d == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif d == -4:
+            d1, d2 = tgt[j + 1], tgt[j + 2]
+            if d1 == -1:
+                d1 = src[i] // d2
+            if d2 == -1:
+                d2 = src[i] // d1
+            out.extend([d1, d2]); i += 1; j += 2
+        else:
+            out.append(d); i += 1
+        j += 1
+    if out.count(-1):
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(src)) if src else 1
+        out = [total // known if d == -1 else d for d in out]
+    return a.reshape(out)
+
+
+alias("reshape", "Reshape")
+
+
+@register("Flatten", hint="flatten")
+def _flatten(p, c, a):
+    return a.reshape((a.shape[0], -1))
+
+
+alias("flatten", "Flatten")
+
+
+@register("transpose", params_spec=(_axis_param("axes", None),))
+def _transpose(p, c, a):
+    axes = p["axes"]
+    if isinstance(axes, int):
+        axes = (axes,)
+    return jnp.transpose(a, axes if axes else None)
+
+
+@register("expand_dims", params_spec=(Param("axis", int, required=True),))
+def _expand_dims(p, c, a):
+    return jnp.expand_dims(a, p["axis"])
+
+
+@register("Concat", params_spec=(Param("num_args", int, required=True),
+                                 Param("dim", int, 1)),
+          input_names=lambda p: ["arg%d" % i for i in range(p["num_args"])],
+          hint="concat")
+def _concat(p, c, *xs):
+    return jnp.concatenate(xs, axis=p["dim"])
+
+
+alias("concat", "Concat")
+
+
+@register("SliceChannel", params_spec=(Param("num_outputs", int, required=True),
+                                       Param("axis", int, 1),
+                                       Param("squeeze_axis", bool, False)),
+          num_outputs=lambda p: p["num_outputs"], hint="slicechannel")
+def _slice_channel(p, c, a):
+    parts = jnp.split(a, p["num_outputs"], axis=p["axis"])
+    if p["squeeze_axis"]:
+        parts = [jnp.squeeze(x, axis=p["axis"]) for x in parts]
+    return tuple(parts)
+
+
+alias("split", "SliceChannel")
+
+
+@register("SwapAxis", params_spec=(Param("dim1", int, 0), Param("dim2", int, 0)),
+          hint="swapaxis")
+def _swapaxis(p, c, a):
+    return jnp.swapaxes(a, p["dim1"], p["dim2"])
+
+
+alias("swapaxes", "SwapAxis")
+
+
+@register("slice", params_spec=(Param("begin", "shape", required=True),
+                                Param("end", "shape", required=True)))
+def _slice(p, c, a):
+    idx = tuple(slice(b, e) for b, e in zip(p["begin"], p["end"]))
+    return a[idx]
+
+
+@register("slice_axis", params_spec=(Param("axis", int, required=True),
+                                     Param("begin", int, required=True),
+                                     Param("end", lambda v: None if v in (None, "None") else int(v), None)))
+def _slice_axis(p, c, a):
+    ax = p["axis"] % a.ndim
+    end = p["end"] if p["end"] is not None else a.shape[ax]
+    idx = [slice(None)] * a.ndim
+    idx[ax] = slice(p["begin"], end)
+    return a[tuple(idx)]
+
+
+@register("Crop", params_spec=(Param("num_args", int, 1),
+                               Param("offset", "shape", (0, 0)),
+                               Param("h_w", "shape", (0, 0)),
+                               Param("center_crop", bool, False)),
+          input_names=lambda p: ["arg%d" % i for i in range(p["num_args"])],
+          hint="crop")
+def _crop(p, c, *xs):
+    a = xs[0]
+    if len(xs) == 2:
+        th, tw = xs[1].shape[2], xs[1].shape[3]
+    else:
+        th, tw = p["h_w"]
+    if p["center_crop"]:
+        oy = (a.shape[2] - th) // 2
+        ox = (a.shape[3] - tw) // 2
+    else:
+        oy, ox = p["offset"]
+    return a[:, :, oy:oy + th, ox:ox + tw]
+
+
+@register("Pad", params_spec=(Param("pad_width", "shape", required=True),
+                              Param("mode", str, "constant",
+                                    enum=("constant", "edge", "reflect")),
+                              Param("constant_value", float, 0.0)),
+          hint="pad")
+def _pad(p, c, a):
+    pw = p["pad_width"]
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(a.ndim)]
+    if p["mode"] == "constant":
+        return jnp.pad(a, pairs, constant_values=p["constant_value"])
+    return jnp.pad(a, pairs, mode=p["mode"])
+
+
+alias("pad", "Pad")
+
+
+@register("tile", params_spec=(Param("reps", "shape", required=True),))
+def _tile(p, c, a):
+    return jnp.tile(a, p["reps"])
+
+
+@register("repeat", params_spec=(Param("repeats", int, required=True),
+                                 _axis_param()))
+def _repeat(p, c, a):
+    return jnp.repeat(a, p["repeats"], axis=p["axis"])
+
+
+@register("reverse", params_spec=(_axis_param("axis", required=True),))
+def _reverse(p, c, a):
+    ax = p["axis"]
+    return jnp.flip(a, ax if isinstance(ax, tuple) else (ax,))
+
+
+alias("flip", "reverse")
+
+
+@register("Cast", params_spec=(Param("dtype", "dtype", required=True),),
+          hint="cast")
+def _cast(p, c, a):
+    return a.astype(p["dtype"])
+
+
+alias("cast", "Cast")
+
+
+@register("broadcast_axis", params_spec=(_axis_param(), Param("size", "shape", ())))
+def _broadcast_axis(p, c, a):
+    ax = p["axis"]
+    axes = (ax,) if isinstance(ax, int) else (ax or ())
+    sizes = p["size"]
+    shape = list(a.shape)
+    for x, s in zip(axes, sizes):
+        shape[x] = s
+    return jnp.broadcast_to(a, shape)
+
+
+alias("broadcast_axes", "broadcast_axis")
+
+
+@register("broadcast_to", params_spec=(Param("shape", "shape", required=True),))
+def _broadcast_to(p, c, a):
+    tgt = [s if s != 0 else a.shape[i] for i, s in enumerate(p["shape"])]
+    return jnp.broadcast_to(a, tgt)
+
+
+# ----------------------------------------------------------------------
+# linear algebra
+@register("dot", params_spec=(Param("transpose_a", bool, False),
+                              Param("transpose_b", bool, False)),
+          input_names=("lhs", "rhs"))
+def _dot(p, c, a, b):
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b).reshape((1,))
+    if p["transpose_a"]:
+        a = a.T
+    if p["transpose_b"]:
+        b = b.T
+    # keep the MXU fed: 2-D matmul in the input dtype, f32 accumulation
+    return jax.lax.dot(a, b, precision=None,
+                       preferred_element_type=_acc_type(a.dtype))
+
+
+def _acc_type(dt):
+    return jnp.float32 if dt in (jnp.bfloat16, jnp.float16) else None
+
+
+@register("batch_dot", params_spec=(Param("transpose_a", bool, False),
+                                    Param("transpose_b", bool, False)),
+          input_names=("lhs", "rhs"))
+def _batch_dot(p, c, a, b):
+    if p["transpose_a"]:
+        a = jnp.swapaxes(a, -1, -2)
+    if p["transpose_b"]:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+# ----------------------------------------------------------------------
+# reductions
+def _reduce(fn, p, a):
+    ax = p["axis"]
+    if isinstance(ax, int):
+        ax = (ax,)
+    out = fn(a, axis=ax, keepdims=p["keepdims"])
+    if ax is None and not p["keepdims"]:
+        out = out.reshape((1,))  # reference: full reduce -> shape (1,)
+    return out
+
+
+_REDUCERS = {
+    "sum": jnp.sum, "mean": jnp.mean, "max": jnp.max, "min": jnp.min,
+    "prod": jnp.prod, "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+}
+for _name, _fn in _REDUCERS.items():
+    register(_name,
+             lambda p, c, a, _fn=_fn: _reduce(_fn, p, a),
+             params_spec=(_axis_param(), Param("keepdims", bool, False)))
+
+alias("sum_axis", "sum")
+alias("max_axis", "max")
+alias("min_axis", "min")
+
+
+@register("norm")
+def _norm(p, c, a):
+    return jnp.sqrt(jnp.sum(a * a)).reshape((1,))
+
+
+@register("argmax", params_spec=(_axis_param(), Param("keepdims", bool, False)))
+def _argmax(p, c, a):
+    ax = p["axis"]
+    out = jnp.argmax(a.reshape(-1) if ax is None else a, axis=0 if ax is None else ax,
+                     keepdims=p["keepdims"] if ax is not None else False)
+    return out.astype(a.dtype)
+
+
+@register("argmin", params_spec=(_axis_param(), Param("keepdims", bool, False)))
+def _argmin(p, c, a):
+    ax = p["axis"]
+    out = jnp.argmin(a.reshape(-1) if ax is None else a, axis=0 if ax is None else ax,
+                     keepdims=p["keepdims"] if ax is not None else False)
+    return out.astype(a.dtype)
+
+
+@register("argmax_channel")
+def _argmax_channel(p, c, a):
+    return jnp.argmax(a, axis=1).astype(a.dtype)
+
+
+@register("topk", params_spec=(_axis_param("axis", -1), Param("k", int, 1),
+                               Param("ret_typ", str, "indices",
+                                     enum=("value", "indices", "mask", "both")),
+                               Param("is_ascend", bool, False)),
+          num_outputs=lambda p: 2 if p.get("ret_typ") == "both" else 1)
+def _topk(p, c, a):
+    ax = p["axis"] if p["axis"] is not None else a.ndim - 1
+    k = p["k"]
+    src = jnp.moveaxis(a, ax, -1)
+    neg = src if not p["is_ascend"] else -src
+    vals, idx = jax.lax.top_k(neg, k)
+    if p["is_ascend"]:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax).astype(a.dtype)
+    if p["ret_typ"] == "value":
+        return vals
+    if p["ret_typ"] == "indices":
+        return idx
+    if p["ret_typ"] == "both":
+        return vals, idx
+    # mask
+    mask = jnp.zeros_like(src)
+    mask = jax.vmap(lambda m, i: m.at[i].set(1.0))(
+        mask.reshape((-1, src.shape[-1])),
+        idx.astype(jnp.int32).reshape((-1, k)))
+    return jnp.moveaxis(mask.reshape(src.shape), -1, ax)
+
+
+@register("sort", params_spec=(_axis_param("axis", -1), Param("is_ascend", bool, True)))
+def _sort(p, c, a):
+    out = jnp.sort(a, axis=p["axis"])
+    return out if p["is_ascend"] else jnp.flip(out, axis=p["axis"])
+
+
+@register("argsort", params_spec=(_axis_param("axis", -1), Param("is_ascend", bool, True)))
+def _argsort(p, c, a):
+    idx = jnp.argsort(a, axis=p["axis"])
+    if not p["is_ascend"]:
+        idx = jnp.flip(idx, axis=p["axis"])
+    return idx.astype(a.dtype)
+
+
+# ----------------------------------------------------------------------
+# indexing
+@register("take", params_spec=(Param("axis", int, 0),
+                               Param("mode", str, "clip",
+                                     enum=("clip", "wrap", "raise"))),
+          input_names=("a", "indices"))
+def _take(p, c, a, indices):
+    mode = p["mode"] if p["mode"] != "raise" else "clip"
+    return jnp.take(a, indices.astype(jnp.int32), axis=p["axis"], mode=mode)
+
+
+@register("batch_take", input_names=("a", "indices"))
+def _batch_take(p, c, a, indices):
+    return jax.vmap(lambda row, i: row[i])(a, indices.astype(jnp.int32))
+
+
+@register("Embedding", params_spec=(Param("input_dim", int, required=True),
+                                    Param("output_dim", int, required=True),
+                                    Param("dtype", "dtype", np.dtype(np.float32))),
+          input_names=("data", "weight"), hint="embedding")
+def _embedding(p, c, data, weight):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode="clip")
+
+
+def _embedding_infer_shape(p, in_shapes):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return None
+    wshape = (p["input_dim"], p["output_dim"])
+    return [dshape, wshape], [tuple(dshape) + (p["output_dim"],)], []
+
+
+from . import registry as _r
+_r.get("Embedding").infer_shape = _embedding_infer_shape
+
+
+@register("pick", params_spec=(_axis_param("axis", -1), Param("keepdims", bool, False)),
+          input_names=("data", "index"))
+def _pick(p, c, a, index):
+    ax = p["axis"] if p["axis"] is not None else a.ndim - 1
+    idx = index.astype(jnp.int32)
+    out = jnp.take_along_axis(a, jnp.expand_dims(idx, ax), axis=ax)
+    if not p["keepdims"]:
+        out = jnp.squeeze(out, axis=ax)
+    return out
+
+
+@register("where", input_names=("condition", "x", "y"))
+def _where(p, c, cond, x, y):
+    return jnp.where(cond.astype(bool), x, y)
+
+
+@register("one_hot", params_spec=(Param("depth", int, required=True),
+                                  Param("on_value", float, 1.0),
+                                  Param("off_value", float, 0.0),
+                                  Param("dtype", "dtype", np.dtype(np.float32))),
+          input_names=("indices",))
+def _one_hot(p, c, indices):
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), p["depth"], dtype=p["dtype"])
+    return oh * (p["on_value"] - p["off_value"]) + p["off_value"]
+
+
+# ----------------------------------------------------------------------
+# gradient-flow control
+@register("BlockGrad", hint="blockgrad")
+def _block_grad(p, c, a):
+    return jax.lax.stop_gradient(a)
+
+
+alias("stop_gradient", "BlockGrad")
+
+
+@register("make_loss_internal")
+def _make_loss_internal(p, c, a):
+    return a
+
+
+@register("zeros_like")
+def _zeros_like(p, c, a):
+    return jnp.zeros_like(a)
+
+
+@register("ones_like")
+def _ones_like(p, c, a):
+    return jnp.ones_like(a)
+
+
+@register("_identity_with_attr_like_rhs", input_names=("lhs", "rhs"))
+def _identity_attr_like(p, c, lhs, rhs):
+    return lhs
+
+
+@register("_CrossDeviceCopy", hint="crossdevicecopy")
+def _cross_device_copy(p, c, a):
+    # device transfer is an XLA/sharding concern; inside a jitted graph this
+    # is identity (reference: src/operator/cross_device_copy.cc)
+    return a

@@ -1,0 +1,38 @@
+"""PRNG management — functional JAX keys behind a stateful facade.
+
+The reference seeds per-device mshadow PRNGs through the resource manager
+(``include/mxnet/resource.h:59-72``, ``MXRandomSeed``).  JAX RNG is
+explicit-key; this module hides a root key + split counter so imperative
+code keeps the reference's stateful API (``mx.random.seed(...)``,
+``mx.nd.uniform(...)``) while every draw is reproducible and jit-safe.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_LOCAL = threading.local()
+
+
+def _root():
+    if not hasattr(_LOCAL, "key"):
+        _LOCAL.key = jax.random.key(0)
+        _LOCAL.count = 0
+    return _LOCAL
+
+
+def seed(seed_state: int):
+    """Seed the generator (reference ``MXRandomSeed``, c_api.h:204)."""
+    _LOCAL.key = jax.random.key(int(seed_state))
+    _LOCAL.count = 0
+
+
+def next_key():
+    st = _root()
+    st.count += 1
+    return jax.random.fold_in(st.key, st.count)
+
+
+# imperative sampling front-ends are generated from the op registry; they are
+# re-exported here by the package __init__ (uniform, normal, ...).
