@@ -1,0 +1,428 @@
+"""Optimizers (reference ``python/mxnet/optimizer.py:10-755``).
+
+Same registry + class surface (SGD, DCASGD, NAG, SGLD, ccSGD, Adam, AdaGrad,
+RMSProp, AdaDelta, Ftrl, Test) and the ``Updater`` state holder used by
+KVStore.  Update math routes through the *fused update ops* registered in
+``op/optimizer_op.py`` (the analog of ``src/operator/optimizer_op.cc:18-98``)
+so a step is one XLA computation per weight; inside a fused Module train
+step the same expressions are inlined and fused with the gradient allreduce.
+"""
+from __future__ import annotations
+
+import logging
+import math
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import ndarray
+
+
+class Optimizer(object):
+    """Base optimizer: lr/wd multipliers, update counting, registry."""
+
+    opt_registry = {}
+
+    def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
+                 clip_gradient=None, learning_rate=0.01,
+                 lr_scheduler=None, sym=None, begin_num_update=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name should be a dict of param indexes to names.")
+        self.idx2name = param_idx2name.copy()
+        self.sym = sym
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        if name in Optimizer.opt_registry:
+            logging.warning("WARNING: New optimizer %s.%s is overriding "
+                            "existing optimizer %s", klass.__module__,
+                            klass.__name__, name)
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        if name.lower() in Optimizer.opt_registry:
+            return Optimizer.opt_registry[name.lower()](**kwargs)
+        raise ValueError("Cannot find optimizer %s" % name)
+
+    def create_state(self, index, weight):
+        """Create per-weight state (momentum...)."""
+        return None
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError()
+
+    def set_lr_scale(self, args_lrscale):
+        raise DeprecationWarning("Use set_lr_mult instead.")
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym is not None:
+            attr = self.sym.attr_dict()
+            for name in self.sym.list_arguments():
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+
+register = Optimizer.register
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum; fused via ``sgd_update``/``sgd_mom_update``
+    (reference ``optimizer.py:278-323``)."""
+
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+        if state is not None:
+            ndarray.sgd_mom_update(weight, grad, state, out=[weight, state],
+                                   momentum=self.momentum, **kwargs)
+        else:
+            ndarray.sgd_update(weight, grad, out=weight, **kwargs)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference ``optimizer.py:325-377``)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, weight.copy())
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                weight.copy())
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
+                                a_max=self.clip_gradient)
+        mom, previous_weight = state
+        dc = grad + wd * weight + self.lamda * grad * grad * (weight - previous_weight)
+        if mom is not None:
+            mom *= self.momentum
+            mom -= lr * dc
+            delta = mom
+        else:
+            delta = -lr * dc
+        previous_weight[:] = weight
+        weight += delta
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD (reference ``optimizer.py:380-413``)."""
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
+                                a_max=self.clip_gradient)
+        if state is not None:
+            mom = state
+            mom *= self.momentum
+            grad += wd * weight
+            mom += grad
+            grad += self.momentum * mom
+            weight -= lr * grad
+        else:
+            weight -= lr * (grad + wd * weight)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference ``optimizer.py:416``)."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
+                                a_max=self.clip_gradient)
+        noise = ndarray.normal(loc=0.0, scale=math.sqrt(lr),
+                               shape=weight.shape, dtype=weight.dtype)
+        weight -= lr / 2 * (grad + wd * weight)
+        weight += noise
+
+
+@register  # noqa: N801 - reference spells it ccSGD
+class ccSGD(SGD):
+    """[Deprecated alias] same as SGD (reference ``optimizer.py:444``)."""
+
+
+@register
+class Adam(Optimizer):
+    """Adam, fused via ``adam_update`` (reference ``optimizer.py:451-496``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        ndarray.adam_update(weight, grad, mean, var,
+                            out=[weight, mean, var],
+                            lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
+                            epsilon=self.epsilon, t=t,
+                            rescale_grad=self.rescale_grad,
+                            clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+
+
+@register
+class AdaGrad(Optimizer):
+    """AdaGrad (reference ``optimizer.py:499-533``)."""
+
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
+                                a_max=self.clip_gradient)
+        history = state
+        history += grad * grad
+        weight -= lr * (grad / ndarray.sqrt(history + self.float_stable_eps)
+                        + wd * weight)
+
+
+@register
+class RMSProp(Optimizer):
+    """RMSProp (Tieleman/Graves variants), fused via ``rmsprop_update``/
+    ``rmspropalex_update`` (reference ``optimizer.py:536-602``)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
+                    zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
+                    zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),)  # n
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      gamma1=self.gamma1, epsilon=self.epsilon,
+                      clip_gradient=self.clip_gradient if self.clip_gradient else -1.0,
+                      clip_weights=self.clip_weights if self.clip_weights else -1.0)
+        if not self.centered:
+            n, = state
+            ndarray.rmsprop_update(weight, grad, n, out=[weight, n], **kwargs)
+        else:
+            n, g, delta = state
+            ndarray.rmspropalex_update(weight, grad, n, g, delta,
+                                       out=[weight, n, g, delta],
+                                       gamma2=self.gamma2, **kwargs)
+
+
+@register
+class AdaDelta(Optimizer):
+    """AdaDelta (reference ``optimizer.py:605-650``)."""
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
+                zeros(weight.shape, weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
+                                a_max=self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
+        current_delta = (ndarray.sqrt(acc_delta + self.epsilon)
+                         / ndarray.sqrt(acc_g + self.epsilon)) * grad
+        acc_delta[:] = self.rho * acc_delta + (1. - self.rho) * current_delta * current_delta
+        weight[:] = weight - current_delta - wd * weight
+
+
+@register
+class Ftrl(Optimizer):
+    """FTRL-proximal (reference ``optimizer.py:653-703``)."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # dn
+                zeros(weight.shape, weight.context, dtype=weight.dtype))  # n
+
+    def update(self, index, weight, grad, state):
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        grad = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
+                                a_max=self.clip_gradient)
+        dn, n = state
+        dn += grad - (ndarray.sqrt(n + grad * grad) - ndarray.sqrt(n)) * weight / lr
+        n += grad * grad
+        w = (ndarray.sign(dn) * self.lamda1 - dn) / \
+            ((self.beta + ndarray.sqrt(n)) / lr + wd) * \
+            (ndarray.abs(dn) > self.lamda1)
+        weight[:] = w
+
+
+@register
+class Test(Optimizer):
+    """Do-nothing-but-add optimizer for kvstore tests
+    (reference ``optimizer.py:706-717``)."""
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight[:] = weight + grad * self.rescale_grad
+        state[:] = weight
+
+
+create = Optimizer.create_optimizer
+
+
+class Updater(object):
+    """Per-index state holder applying an Optimizer
+    (reference ``optimizer.py:722-744``)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state(index, weight)
+        self.optimizer.update(index, weight, grad, self.states[index])
+
+    def set_states(self, states):
+        self.states = pickle.loads(states)
+
+    def get_states(self):
+        return pickle.dumps(self.states)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

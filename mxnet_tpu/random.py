@@ -10,6 +10,8 @@ from __future__ import annotations
 
 import threading
 
+import numpy as _np
+
 import jax
 
 _LOCAL = threading.local()
@@ -26,6 +28,16 @@ def seed(seed_state: int):
     """Seed the generator (reference ``MXRandomSeed``, c_api.h:204)."""
     _LOCAL.key = jax.random.key(int(seed_state))
     _LOCAL.count = 0
+    _LOCAL.np_rng = _np.random.RandomState(int(seed_state))
+
+
+def np_rng():
+    """Host-side numpy RNG (weight init, data shuffling) sharing the seed
+    set by :func:`seed` — keeps init one-time and off the compiled path."""
+    st = _root()
+    if not hasattr(st, "np_rng"):
+        st.np_rng = _np.random.RandomState(0)
+    return st.np_rng
 
 
 def next_key():
