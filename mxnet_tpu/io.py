@@ -1,0 +1,764 @@
+"""Data iterators (reference ``python/mxnet/io.py`` + the C++ iterators in
+``src/io/``).
+
+The reference composes C++ stages ``Prefetcher(BatchLoader(Normalize(
+Parser)))`` behind ``MXDataIterCreateIter``; here the same contract
+(``provide_data``/``provide_label``, ``DataBatch{data,label,pad,index}``,
+``reset/iter_next``) is met by Python iterators that stage host numpy
+batches and hand the device transfer to JAX — double-buffered by
+``PrefetchingIter`` (the analog of ``iter_prefetcher.h:28-129``'s
+``ThreadedIter``) so input decode overlaps TPU compute.
+
+Included C++-iterator equivalents: ``MNISTIter`` (``src/io/iter_mnist.cc``),
+``CSVIter`` (``iter_csv.cc``), ``ImageRecordIter``
+(``iter_image_recordio_2.cc`` incl. OMP-style threaded JPEG decode via a
+thread pool, shuffle, part_index/num_parts sharding, and the default
+augmenters of ``image_aug_default.cc``).
+"""
+from __future__ import annotations
+
+import io as _pyio
+import logging
+import os
+import queue
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from .base import MXNetError, mx_real_t, _dtype
+from .ndarray import NDArray, array
+from . import ndarray as nd
+from . import recordio as _recordio
+from . import random as _random
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    """Name/shape/dtype/layout descriptor (reference ``io.py:19-79``)."""
+
+    def __new__(cls, name, shape, dtype=mx_real_t, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+    def __repr__(self):
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
+                                          self.layout)
+
+    @staticmethod
+    def get_batch_axis(layout):
+        if layout is None:
+            return 0
+        return layout.find("N")
+
+    @staticmethod
+    def get_list(shapes, types):
+        if types is not None:
+            type_dict = dict(types)
+            return [DataDesc(x[0], x[1], type_dict[x[0]]) for x in shapes]
+        return [DataDesc(x[0], x[1]) for x in shapes]
+
+
+class DataBatch(object):
+    """One batch: data/label lists of NDArray + padding info
+    (reference ``io.py:82-123``)."""
+
+    def __init__(self, data, label, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        label_shapes = [l.shape for l in self.label] if self.label else []
+        return "{}: data shapes: {} label shapes: {}".format(
+            self.__class__.__name__, data_shapes, label_shapes)
+
+
+class DataIter(object):
+    """Base iterator (reference ``io.py:126-213``)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        pass
+
+    def getdata(self):
+        pass
+
+    def getlabel(self):
+        pass
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        pass
+
+
+class ResizeIter(DataIter):
+    """Resize the epoch length of another iterator
+    (reference ``io.py:216-278``)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__()
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+        self.provide_data = data_iter.provide_data
+        self.provide_label = data_iter.provide_label
+        self.batch_size = data_iter.batch_size
+        if hasattr(data_iter, "default_bucket_key"):
+            self.default_bucket_key = data_iter.default_bucket_key
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Double-buffering prefetcher over one or more iterators
+    (reference ``io.py:281-423``; C++ analog ``iter_prefetcher.h``).
+
+    Producer threads pull from the wrapped iterators while the consumer
+    (the training loop / TPU step) works on the previous batch, overlapping
+    host decode with device compute.
+    """
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        super().__init__()
+        if not isinstance(iters, list):
+            iters = [iters]
+        self.n_iter = len(iters)
+        if self.n_iter < 1:
+            raise MXNetError("PrefetchingIter needs at least one iterator")
+        self.iters = iters
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = self.provide_data[0].shape[0]
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
+            for i in range(self.n_iter)]
+        for thread in self.prefetch_threads:
+            thread.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+        for thread in self.prefetch_threads:
+            thread.join(timeout=1.0)
+
+    @property
+    def provide_data(self):
+        if self.rename_data is None:
+            return sum([i.provide_data for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_data]
+                    for r, i in zip(self.rename_data, self.iters)], [])
+
+    @property
+    def provide_label(self):
+        if self.rename_label is None:
+            return sum([i.provide_label for i in self.iters], [])
+        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
+                     if isinstance(x, DataDesc) else DataDesc(*x)
+                     for x in i.provide_label]
+                    for r, i in zip(self.rename_label, self.iters)], [])
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            for i in self.next_batch:
+                assert i is None, "Number of entry mismatches between iterators"
+            return False
+        for batch in self.next_batch:
+            assert batch.pad == self.next_batch[0].pad, \
+                "Different pad number in the data batches"
+        self.current_batch = DataBatch(
+            sum([batch.data for batch in self.next_batch], []),
+            sum([batch.label for batch in self.next_batch], []),
+            self.next_batch[0].pad,
+            self.next_batch[0].index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+def _init_data(data, allow_empty, default_name):
+    """Normalize data into a list of (name, numpy) pairs
+    (reference ``io.py:424-452``)."""
+    assert data is not None or allow_empty
+    if data is None:
+        data = []
+    if isinstance(data, (np.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, list):
+        if not allow_empty:
+            assert len(data) > 0
+        if len(data) == 1:
+            data = {default_name: data[0]}
+        else:
+            data = {"_%d_%s" % (i, default_name): d for i, d in enumerate(data)}
+    if not isinstance(data, dict):
+        raise TypeError("Input must be NDArray, numpy.ndarray, a list of them "
+                        "or dict with them as values")
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            try:
+                data[k] = array(v)
+            except Exception:
+                raise TypeError("Invalid type '%s' for %s, should be NDArray "
+                                "or numpy.ndarray" % (type(v), k))
+    return list(sorted(data.items()))
+
+
+class NDArrayIter(DataIter):
+    """Iterate over in-memory arrays (reference ``io.py:453-610``)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True, default_name=label_name)
+
+        self.idx = np.arange(self.data[0][1].shape[0])
+        if shuffle:
+            _random.np_rng().shuffle(self.idx)
+            self.data = [(k, array(v.asnumpy()[self.idx], dtype=v.dtype))
+                         for k, v in self.data]
+            self.label = [(k, array(v.asnumpy()[self.idx], dtype=v.dtype))
+                          for k, v in self.label]
+
+        if last_batch_handle == "discard":
+            new_n = self.data[0][1].shape[0] - self.data[0][1].shape[0] % batch_size
+            data_dict = dict(self.data)
+            label_dict = dict(self.label)
+            for k, _ in self.data:
+                data_dict[k] = data_dict[k][:new_n]
+            for k, _ in self.label:
+                label_dict[k] = label_dict[k][:new_n]
+            self.data = [(k, data_dict[k]) for k, _ in self.data]
+            self.label = [(k, label_dict[k]) for k, _ in self.label]
+
+        self.data_list = [x[1] for x in self.data] + [x[1] for x in self.label]
+        self.num_source = len(self.data_list)
+        self.num_data = self.data_list[0].shape[0]
+        assert self.num_data >= batch_size, \
+            "batch_size needs to be smaller than data size."
+        self.cursor = -batch_size
+        self.last_batch_handle = last_batch_handle
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.label]
+
+    def hard_reset(self):
+        self.cursor = -self.batch_size
+
+    def reset(self):
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=None)
+        raise StopIteration
+
+    def _getdata(self, data_source):
+        assert self.cursor < self.num_data, "DataIter needs reset."
+        if self.cursor + self.batch_size <= self.num_data:
+            return [x[1][self.cursor:self.cursor + self.batch_size]
+                    for x in data_source]
+        # padding with wrap-around
+        pad = self.batch_size - self.num_data + self.cursor
+        return [array(np.concatenate(
+            [x[1].asnumpy()[self.cursor:], x[1].asnumpy()[:pad]], axis=0),
+            dtype=x[1].dtype)
+            for x in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+# ----------------------------------------------------------------------
+# C++-iterator equivalents (registered iterators in the reference)
+class MNISTIter(DataIter):
+    """MNIST idx-ubyte reader (reference ``src/io/iter_mnist.cc``)."""
+
+    def __init__(self, image="train-images-idx3-ubyte",
+                 label="train-labels-idx1-ubyte", batch_size=128, shuffle=True,
+                 flat=False, silent=False, seed=0, part_index=0, num_parts=1,
+                 **kwargs):
+        super().__init__(int(batch_size))
+        img = self._read_images(image)
+        lbl = self._read_labels(label)
+        assert img.shape[0] == lbl.shape[0]
+        if int(num_parts) > 1:
+            n = img.shape[0] // int(num_parts)
+            s = int(part_index) * n
+            img, lbl = img[s:s + n], lbl[s:s + n]
+        if _parse_bool(shuffle):
+            rng = np.random.RandomState(int(seed))
+            perm = rng.permutation(img.shape[0])
+            img, lbl = img[perm], lbl[perm]
+        img = img.astype(np.float32) / 255.0
+        if _parse_bool(flat):
+            img = img.reshape(img.shape[0], -1)
+        else:
+            img = img.reshape(img.shape[0], 1, 28, 28)
+        self._iter = NDArrayIter(img, lbl.astype(np.float32),
+                                 batch_size=int(batch_size),
+                                 data_name="data", label_name="softmax_label")
+        if not _parse_bool(silent):
+            logging.info("MNISTIter: load %d images", img.shape[0])
+
+    @staticmethod
+    def _read_images(path):
+        with _maybe_gzip(path) as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise MXNetError("invalid MNIST image file %s" % path)
+            return np.frombuffer(f.read(num * rows * cols),
+                                 dtype=np.uint8).reshape(num, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        with _maybe_gzip(path) as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise MXNetError("invalid MNIST label file %s" % path)
+            return np.frombuffer(f.read(num), dtype=np.uint8)
+
+    @property
+    def provide_data(self):
+        return self._iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self._iter.provide_label
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+
+def _maybe_gzip(path):
+    if path.endswith(".gz"):
+        import gzip
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _parse_bool(v):
+    if isinstance(v, str):
+        return v.lower() in ("true", "1", "yes")
+    return bool(v)
+
+
+class CSVIter(DataIter):
+    """CSV reader (reference ``src/io/iter_csv.cc``)."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=128, round_batch=True, **kwargs):
+        super().__init__(int(batch_size))
+        data_shape = _as_shape(data_shape)
+        label_shape = _as_shape(label_shape)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        data = data.reshape((-1,) + data_shape)
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",", dtype=np.float32,
+                               ndmin=2)
+            label = label.reshape((-1,) + label_shape)
+            if label_shape == (1,):
+                label = label.reshape(-1)
+        else:
+            label = np.zeros((data.shape[0],), dtype=np.float32)
+        self._iter = NDArrayIter(data, label, batch_size=int(batch_size),
+                                 last_batch_handle="pad" if _parse_bool(round_batch) else "discard",
+                                 data_name="data", label_name="label")
+
+    provide_data = property(lambda self: self._iter.provide_data)
+    provide_label = property(lambda self: self._iter.provide_label)
+
+    def reset(self):
+        self._iter.reset()
+
+    def next(self):
+        return self._iter.next()
+
+    def iter_next(self):
+        return self._iter.iter_next()
+
+    def getdata(self):
+        return self._iter.getdata()
+
+    def getlabel(self):
+        return self._iter.getlabel()
+
+    def getpad(self):
+        return self._iter.getpad()
+
+
+def _as_shape(s):
+    if isinstance(s, str):
+        import ast
+        s = ast.literal_eval(s)
+    if isinstance(s, int):
+        return (s,)
+    return tuple(int(x) for x in s)
+
+
+class ImageRecordIter(DataIter):
+    """RecordIO image iterator with threaded decode + augmentation.
+
+    Python-native equivalent of ``src/io/iter_image_recordio_2.cc:28-120``
+    (parser with OMP decode threads) + ``image_aug_default.cc`` (resize,
+    random/center crop, mirror, HSL jitter) + normalize/batch/prefetch
+    stages.  Decode parallelism = ``preprocess_threads``; a producer thread
+    double-buffers ready batches so device steps overlap decode.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False,
+                 rand_crop=False, rand_mirror=False, mean_img=None,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, scale=1.0, resize=-1, max_random_scale=1.0,
+                 min_random_scale=1.0, max_rotate_angle=0,
+                 max_aspect_ratio=0.0, random_h=0, random_s=0, random_l=0,
+                 preprocess_threads=4, prefetch_buffer=4, part_index=0,
+                 num_parts=1, round_batch=True, seed=0, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(int(batch_size))
+        self.data_shape = _as_shape(data_shape)
+        assert len(self.data_shape) == 3, "data_shape must be (c, h, w)"
+        self.label_width = int(label_width)
+        self.shuffle = _parse_bool(shuffle)
+        self.rand_crop = _parse_bool(rand_crop)
+        self.rand_mirror = _parse_bool(rand_mirror)
+        self.scale = float(scale)
+        self.resize = int(resize)
+        self.mean = None
+        if mean_img is not None and os.path.isfile(str(mean_img)):
+            m = nd.load(str(mean_img))
+            self.mean = list(m.values())[0].asnumpy() if isinstance(m, dict) \
+                else m[0].asnumpy()
+        elif float(mean_r) or float(mean_g) or float(mean_b):
+            self.mean = np.array([float(mean_b), float(mean_g),
+                                  float(mean_r)]).reshape(3, 1, 1)
+        self.std = np.array([float(std_b), float(std_g),
+                             float(std_r)]).reshape(3, 1, 1)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.rng = np.random.RandomState(int(seed))
+
+        self._record = _recordio.MXIndexedRecordIO(
+            path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx",
+            path_imgrec, "r") if (path_imgidx or os.path.isfile(
+                os.path.splitext(path_imgrec)[0] + ".idx")) \
+            else _recordio.MXRecordIO(path_imgrec, "r")
+        # scan record offsets once so shuffle/sharding can seek
+        self._offsets = self._scan_offsets(path_imgrec)
+        n = len(self._offsets) // int(num_parts)
+        self._offsets = self._offsets[int(part_index) * n:
+                                      (int(part_index) + 1) * n]
+        self._order = np.arange(len(self._offsets))
+        self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads))
+        self._queue: "queue.Queue" = queue.Queue(maxsize=int(prefetch_buffer))
+        self._producer = None
+        self._stop = threading.Event()
+        self._epoch_done = False
+        self.reset()
+
+    @staticmethod
+    def _scan_offsets(path):
+        offsets = []
+        with open(path, "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            pos = 0
+            while pos < size:
+                offsets.append(pos)
+                while True:
+                    head = f.read(8)
+                    if len(head) < 8:
+                        pos = size
+                        break
+                    magic, lrec = struct.unpack("<II", head)
+                    cflag, length = _decode_lrec_mod(lrec)
+                    f.seek(length + ((-length) % 4), 1)
+                    pos = f.tell()
+                    if cflag in (0, 3):
+                        break
+        return offsets
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    # -- producer pipeline ---------------------------------------------
+    def reset(self):
+        self._drain()
+        if self.shuffle:
+            self.rng.shuffle(self._order)
+        self._epoch_done = False
+        self._stop.clear()
+        self._producer = threading.Thread(target=self._produce, daemon=True)
+        self._producer.start()
+
+    def _drain(self):
+        if self._producer is not None:
+            self._stop.set()
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._producer.join(timeout=5.0)
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._producer = None
+
+    def _read_record(self, offset):
+        self._record.fio.seek(offset)
+        return self._record.read()
+
+    def _decode_one(self, raw):
+        header, img = _recordio.unpack_img(raw)
+        label = np.asarray(header.label, dtype=np.float32) \
+            if header.flag > 0 else np.float32(header.label)
+        return self._augment(img), label
+
+    def _augment(self, img):
+        """resize -> random-scale -> crop -> mirror -> normalize; CHW out."""
+        from PIL import Image
+        c, h, w = self.data_shape
+        if img.ndim == 2:
+            img = np.stack([img] * 3, axis=2)
+        if self.resize > 0:
+            ih, iw = img.shape[:2]
+            short = min(ih, iw)
+            ratio = self.resize / short
+            pil = Image.fromarray(img[:, :, ::-1])
+            pil = pil.resize((max(w, int(iw * ratio)),
+                              max(h, int(ih * ratio))), Image.BILINEAR)
+            img = np.asarray(pil)[:, :, ::-1]
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            pil = Image.fromarray(img[:, :, ::-1])
+            pil = pil.resize((max(w, iw), max(h, ih)), Image.BILINEAR)
+            img = np.asarray(pil)[:, :, ::-1]
+            ih, iw = img.shape[:2]
+        if self.rand_crop:
+            y = self.rng.randint(0, ih - h + 1)
+            x = self.rng.randint(0, iw - w + 1)
+        else:
+            y = (ih - h) // 2
+            x = (iw - w) // 2
+        img = img[y:y + h, x:x + w]
+        if self.rand_mirror and self.rng.rand() < 0.5:
+            img = img[:, ::-1]
+        chw = img.transpose(2, 0, 1).astype(np.float32)
+        if self.mean is not None:
+            chw = chw - self.mean
+        chw = chw / self.std
+        return chw * self.scale
+
+    def _produce(self):
+        bs = self.batch_size
+        n = len(self._order)
+        i = 0
+        while i < n and not self._stop.is_set():
+            idxs = self._order[i:i + bs]
+            pad = bs - len(idxs)
+            if pad > 0:
+                idxs = np.concatenate([idxs, self._order[:pad]])
+            raws = [self._read_record(self._offsets[j]) for j in idxs]
+            decoded = list(self._pool.map(self._decode_one, raws))
+            data = np.stack([d for d, _ in decoded])
+            labels = np.stack([l for _, l in decoded])
+            if self.label_width == 1:
+                labels = labels.reshape(bs)
+            try:
+                self._queue.put((data, labels, pad, idxs.copy()), timeout=60)
+            except queue.Full:
+                if self._stop.is_set():
+                    return
+            i += bs
+        self._queue.put(None)
+
+    def next(self):
+        item = self._queue.get()
+        if item is None:
+            self._epoch_done = True
+            raise StopIteration
+        data, labels, pad, idxs = item
+        return DataBatch(data=[array(data)], label=[array(labels)],
+                         pad=pad, index=idxs)
+
+    def iter_next(self):
+        try:
+            self._next_batch = self.next()
+            return True
+        except StopIteration:
+            return False
+
+
+def _decode_lrec_mod(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+# Factory parity with the registered C++ iterators
+ImageRecordIter_v1 = ImageRecordIter

@@ -1,0 +1,240 @@
+"""RecordIO: the reference's packed binary record format, in pure Python.
+
+Wire format is dmlc-core's recordio (used by ``src/io/iter_image_recordio*``
+and exposed through ``c_api.h:1408-1466``): every record is written as
+``[kMagic][lrec][payload][pad-to-4]`` where ``lrec`` packs a 3-bit
+continuation flag and 29-bit length; payloads containing the magic word are
+split at those words and rejoined on read.  Files written here are readable
+by the reference and vice versa.
+
+``IRHeader``/``pack``/``unpack``/``pack_img``/``unpack_img`` mirror
+``python/mxnet/recordio.py:170-260`` (image codec via PIL instead of cv2 —
+the TPU host has no OpenCV dependency).
+"""
+from __future__ import annotations
+
+import io as _pyio
+import numbers
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+from .base import MXNetError
+
+kMagic = 0xced7230a
+_MAGIC_BYTES = struct.pack("<I", kMagic)
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO(object):
+    """Sequential record reader/writer (reference ``recordio.py:19-97``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fio = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fio = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.is_open = True
+
+    def __del__(self):
+        self.close()
+
+    def close(self):
+        if not self.is_open:
+            return
+        self.fio.close()
+        self.is_open = False
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fio.tell()
+
+    def write(self, buf):
+        if not self.writable:
+            raise MXNetError("recordio is read-only")
+        data = memoryview(bytes(buf))
+        # split payload at aligned magic words (dmlc RecordIOWriter semantics)
+        n_words = len(data) >> 2
+        words = np.frombuffer(data[:n_words * 4], dtype="<u4")
+        magic_pos = np.nonzero(words == kMagic)[0]
+        segments = []
+        start = 0
+        for w in magic_pos:
+            segments.append(data[start:w * 4])
+            start = (w + 1) * 4
+        segments.append(data[start:])
+        for i, seg in enumerate(segments):
+            if len(segments) == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == len(segments) - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.fio.write(_MAGIC_BYTES)
+            self.fio.write(struct.pack("<I", _encode_lrec(cflag, len(seg))))
+            self.fio.write(seg)
+            pad = (-len(seg)) % 4
+            if pad:
+                self.fio.write(b"\x00" * pad)
+
+    def read(self):
+        if self.writable:
+            raise MXNetError("recordio is write-only")
+        chunks = []
+        while True:
+            head = self.fio.read(8)
+            if len(head) < 8:
+                return None if not chunks else b"".join(chunks)
+            magic, lrec = struct.unpack("<II", head)
+            if magic != kMagic:
+                raise MXNetError("invalid record magic %x" % magic)
+            cflag, length = _decode_lrec(lrec)
+            payload = self.fio.read(length)
+            pad = (-length) % 4
+            if pad:
+                self.fio.read(pad)
+            if cflag == 0:
+                return payload
+            if chunks:
+                chunks.append(_MAGIC_BYTES)
+            chunks.append(payload)
+            if cflag == 3:
+                return b"".join(chunks)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Record file + ``.idx`` sidecar for random access
+    (reference ``recordio.py:100-169``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write("%s\t%d\n" % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        if self.writable:
+            raise MXNetError("seek on a writer")
+        self.fio.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.fio.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack an IRHeader + payload (reference ``recordio.py:172-192``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, numbers.Number):
+        header = header._replace(flag=0)
+    else:
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, *header) + s
+
+
+def unpack(s):
+    """Unpack to (IRHeader, payload) (reference ``recordio.py:193-214``)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        header = header._replace(
+            label=np.frombuffer(s, np.float32, header.flag))
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack a packed image record to (IRHeader, ndarray HWC BGR) —
+    reference ``recordio.py:215-237`` (cv2.imdecode semantics)."""
+    from PIL import Image
+    header, s = unpack(s)
+    img = Image.open(_pyio.BytesIO(s))
+    if iscolor == 0:
+        img = img.convert("L")
+    elif iscolor == 1 or (iscolor == -1 and img.mode != "L"):
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 3:
+        arr = arr[:, :, ::-1]  # RGB -> BGR to match the cv2-based reference
+    return header, arr
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """JPEG/PNG-encode an image array and pack it
+    (reference ``recordio.py:238-269``)."""
+    from PIL import Image
+    arr = np.asarray(img)
+    if arr.ndim == 3:
+        arr = arr[:, :, ::-1]  # BGR -> RGB for PIL
+    pil = Image.fromarray(arr.astype(np.uint8))
+    buf = _pyio.BytesIO()
+    fmt = img_fmt.lower()
+    if fmt in (".jpg", ".jpeg"):
+        pil.save(buf, format="JPEG", quality=quality)
+    elif fmt == ".png":
+        pil.save(buf, format="PNG", compress_level=min(9, quality // 10))
+    else:
+        raise MXNetError("unsupported image format %s" % img_fmt)
+    return pack(header, buf.getvalue())
