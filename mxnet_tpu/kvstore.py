@@ -1,0 +1,224 @@
+"""KVStore: parameter synchronization facade.
+
+Reference: ``include/mxnet/kvstore.h:26-303`` + ``src/kvstore/``.  The
+reference has two tiers — an intra-node ``Comm`` tree (``comm.h:17-320``)
+and a ps-lite parameter-server for ``dist_*`` modes (``kvstore_dist.h``).
+On TPU both collapse into XLA collectives:
+
+* ``local``/``device``: values pushed from N logical devices are merged with
+  one ``jnp`` add-n (XLA fuses this into a single kernel over HBM; with
+  arrays sharded over a mesh it lowers to an ICI all-reduce) — the analog of
+  ``CommDevice::Reduce``/``CommCPU::ReduceSumCPU``.
+* ``dist_sync_tpu`` (also accepted: ``dist_sync``, ``dist_device_sync``,
+  ``dist``): multi-host data parallelism via ``jax.distributed`` —
+  rank = ``jax.process_index()``; cross-host gradient sums ride the same
+  ``psum`` inside the sharded train step, so there is *no server role*.
+  The sync-mode semantics of ``kvstore_dist_server.h:164-210`` (aggregate
+  all workers, update once, identical pulls) hold by construction because
+  the allreduced update is deterministic and replicated.
+* ``dist_async`` has no ICI analog (XLA collectives are bulk-synchronous);
+  creating it raises with an explanatory error.
+
+The python-facing API (init/push/pull/set_optimizer/_set_updater/_barrier,
+``save_optimizer_states``) mirrors ``python/mxnet/kvstore.py``.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros
+from . import optimizer as opt
+
+
+def _key_list(key):
+    if isinstance(key, (str, int)):
+        return [key], True
+    return list(key), False
+
+
+def _val_list_list(vals, single_key):
+    """Normalize to list-of-(list of NDArray per key)."""
+    if single_key:
+        if isinstance(vals, NDArray):
+            return [[vals]]
+        return [list(vals) if isinstance(vals, (list, tuple)) else [vals]]
+    out = []
+    for v in vals:
+        if isinstance(v, NDArray):
+            out.append([v])
+        else:
+            out.append(list(v))
+    return out
+
+
+class KVStore(object):
+    """In-process key-value store with collective merge semantics."""
+
+    def __init__(self, kind="local"):
+        self._kind = kind
+        self._stored = {}
+        self._updater = None
+        self._optimizer = None
+
+    # ------------------------------------------------------------------
+    def init(self, key, value):
+        """Initialize keys; on dist modes rank-0's value wins by definition
+        (all ranks compute identical inits from the same seed — the analog
+        of ``kvstore_dist.h:63-80`` rank-0-only init push)."""
+        keys, single = _key_list(key)
+        vals = _val_list_list(value, single)
+        for k, vlist in zip(keys, vals):
+            if k in self._stored:
+                continue
+            self._stored[k] = vlist[0].copy()
+
+    def push(self, key, value, priority=0):
+        keys, single = _key_list(key)
+        vals = _val_list_list(value, single)
+        for k, vlist in zip(keys, vals):
+            if k not in self._stored:
+                raise MXNetError("key %s not initialized" % str(k))
+            merged = self._merge(vlist)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._stored[k])
+            else:
+                self._stored[k] += merged
+
+    def pull(self, key, out=None, priority=0):
+        assert out is not None
+        keys, single = _key_list(key)
+        outs = _val_list_list(out, single)
+        for k, olist in zip(keys, outs):
+            if k not in self._stored:
+                raise MXNetError("key %s not initialized" % str(k))
+            src = self._stored[k]
+            for o in olist:
+                o._set_data(src.data.astype(o.dtype))
+
+    def _merge(self, vlist):
+        """Sum values pushed from N logical devices — one fused add-n
+        (Comm tree-reduce analog)."""
+        if len(vlist) == 1:
+            merged = vlist[0].copy()
+        else:
+            import jax.numpy as jnp
+            acc = vlist[0].data
+            for v in vlist[1:]:
+                acc = acc + v.data
+            merged = NDArray(acc)
+        return merged
+
+    # ------------------------------------------------------------------
+    def set_optimizer(self, optimizer):
+        """Register an optimizer.  The reference pickles it to the servers
+        (``kvstore.py set_optimizer``); with no server role it is applied
+        locally — same math, deterministic across replicas."""
+        self._optimizer = optimizer
+        self._set_updater(opt.get_updater(optimizer))
+
+    @property
+    def type(self):
+        return self._kind
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def save_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states())
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def _barrier(self):
+        pass
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def _updater_key(k):
+    """Reference updaters receive int keys; Module uses str — pass through."""
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+class KVStoreTPU(KVStore):
+    """Multi-host synchronous store over jax.distributed.
+
+    ``rank``/``num_workers`` come from the JAX coordination service
+    (replacing ``DMLC_ROLE``/``ps::Postoffice``); cross-host merges use a
+    ``psum`` over the global mesh.  In a single-process run it degrades to
+    the local store with rank 0 / size 1, which is how the reference's
+    dist tests run under the local launcher trick.
+    """
+
+    def __init__(self, kind):
+        super().__init__(kind)
+        import jax
+        self._jax = jax
+
+    @property
+    def rank(self):
+        try:
+            return self._jax.process_index()
+        except Exception:
+            return 0
+
+    @property
+    def num_workers(self):
+        try:
+            return self._jax.process_count()
+        except Exception:
+            return 1
+
+    def _merge(self, vlist):
+        merged = super()._merge(vlist)
+        if self.num_workers > 1:
+            # cross-host sum over DCN/ICI: one psum per key outside the
+            # step; models using Module get this fused into the train step
+            from .parallel.collectives import global_allreduce
+            merged = NDArray(global_allreduce(merged.data))
+        return merged
+
+    def _barrier(self):
+        if self.num_workers > 1:
+            from .parallel.collectives import barrier
+            barrier()
+
+
+def create(name="local"):
+    """Create a KVStore (reference ``kvstore.py:379``; factory strings
+    ``src/kvstore/kvstore.cc:17-45``)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    kind = name.lower()
+    if kind in ("local", "local_update_cpu", "local_allreduce_cpu",
+                "local_allreduce_device", "device"):
+        return KVStore(kind)
+    if kind in ("dist_sync", "dist_sync_tpu", "dist_sync_device",
+                "dist_device_sync", "dist"):
+        return KVStoreTPU(kind)
+    if kind.startswith("dist_async"):
+        raise MXNetError(
+            "dist_async has no TPU analog: XLA collectives are bulk-"
+            "synchronous over ICI. Use dist_sync_tpu (allreduce) instead.")
+    raise MXNetError("unknown kvstore type %s" % name)

@@ -1,0 +1,24 @@
+"""TPU parallelism subsystem.
+
+This package is the TPU-native replacement for the reference's entire
+distribution stack (``src/kvstore/comm.h`` device trees, ps-lite servers,
+``tools/launch.py`` process trackers, per-layer ``group2ctx`` placement):
+one device Mesh + sharding annotations, with XLA inserting the collectives.
+
+  * :mod:`mesh`        — named device meshes (data/model/pipe/seq axes)
+  * :mod:`collectives` — psum/broadcast/barrier over the mesh (ICI/DCN)
+  * :mod:`optim`       — optimizer update rules as pure pytree functions
+  * :mod:`trainer`     — the fused train step: fwd+bwd+allreduce+update in
+                         ONE jitted XLA computation (BASELINE north star)
+  * :mod:`ring_attention` — sequence-parallel blockwise attention over an
+                         ICI ring (long-context first-class support)
+"""
+from .mesh import (Mesh, get_mesh, current_mesh, data_parallel_mesh,
+                   make_mesh)
+from .collectives import global_allreduce, barrier
+from .trainer import Trainer
+from .ring_attention import ring_attention, ring_attention_sharded
+
+__all__ = ["Mesh", "get_mesh", "current_mesh", "data_parallel_mesh",
+           "make_mesh", "global_allreduce", "barrier", "Trainer",
+           "ring_attention", "ring_attention_sharded"]

@@ -1,0 +1,67 @@
+"""Collectives over the mesh.
+
+The reference's two comm layers (intra-node ``Comm`` tree
+``src/kvstore/comm.h:17-320``; inter-node ps-lite ZPush/ZPull
+``kvstore_dist.h:108-241``) both become XLA collectives here: ``psum``
+rides ICI within a slice and DCN across slices, scheduled by the compiler
+inside the step that produces the operands — which is what lets gradient
+allreduce overlap the backward pass (reference hard part; see
+``SURVEY.md`` §7).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .mesh import get_mesh
+
+__all__ = ["global_allreduce", "barrier", "psum_over_mesh"]
+
+
+def global_allreduce(value):
+    """Sum ``value`` across all participating processes/devices.
+
+    For a multi-host run this is the out-of-step analog of the reference's
+    ``KVStoreDist::Push_`` network path; models trained through the fused
+    step never call it — their psum is inside the compiled step.
+    """
+    try:
+        n_proc = jax.process_count()
+    except Exception:
+        n_proc = 1
+    if n_proc <= 1:
+        return value
+    mesh = get_mesh()
+
+    def _sum(x):
+        return jax.lax.psum(x, axis_name="data")
+
+    f = jax.jit(
+        jax.shard_map(_sum, mesh=mesh,
+                      in_specs=PartitionSpec(*(["data"] + [None] * (value.ndim - 1))),
+                      out_specs=PartitionSpec(*([None] * value.ndim))))
+    # value is host-local; make it a global sharded array first
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, PartitionSpec("data")), np.asarray(value))
+    return f(garr)
+
+
+def psum_over_mesh(x, axis_name="data"):
+    """In-step psum — call inside a shard_map'd/pjit'd computation."""
+    return jax.lax.psum(x, axis_name=axis_name)
+
+
+def barrier():
+    """Cross-process rendezvous (reference ``ps::Postoffice::Barrier``,
+    ``kvstore_dist.h:142-145``)."""
+    try:
+        if jax.process_count() > 1:
+            # a tiny allreduce acts as the barrier on the coordination svc
+            jnp.zeros(()).block_until_ready()
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu_barrier")
+    except Exception:
+        pass

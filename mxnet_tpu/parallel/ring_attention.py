@@ -1,0 +1,101 @@
+"""Ring attention: sequence-parallel exact attention over an ICI ring.
+
+Long-context support is first-class in this framework (the 2017-era
+reference predates attention entirely — ``SURVEY.md`` §5 long-context:
+its only tools were bucketing and truncated BPTT).  Ring attention shards
+the sequence across the mesh ``seq`` axis; each device holds a Q block and
+rotates K/V blocks around the ring with ``lax.ppermute`` while accumulating
+the softmax online (flash-attention style running max/denominator), so
+peak memory is O(T/N) and the K/V transfer rides one ICI hop per step,
+overlapped by XLA with the local block matmul.
+
+``ring_attention`` is the per-shard computation (call under ``shard_map``);
+``ring_attention_sharded`` wraps a global array end-to-end.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec
+
+__all__ = ["ring_attention", "ring_attention_sharded", "attention_reference"]
+
+
+def ring_attention(q, k, v, axis_name="seq", causal=False, scale=None):
+    """Blockwise attention over a ring.
+
+    Args: ``q, k, v`` local shards of shape ``[batch, t_local, heads, dim]``
+    inside a ``shard_map`` over ``axis_name``.  Returns the local output
+    shard ``[batch, t_local, heads, dim]``.
+    """
+    n_shards = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q32 = q.astype(jnp.float32) * scale
+
+    perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+
+    def body(i, carry):
+        o, m, l, k_blk, v_blk = carry
+        # after i rotations we hold the block originally on (my_idx - i)
+        blk_idx = (my_idx - i) % n_shards
+        s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
+        if causal:
+            q_pos = my_idx * t + jnp.arange(t)
+            k_pos = blk_idx * t + jnp.arange(t)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None, :, :], s, -jnp.inf)
+        m_blk = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_blk)
+        # -inf rows (fully masked block) must not poison the state
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe[..., None])
+        p = jnp.where(jnp.isneginf(s), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = (o * corr[..., None]
+                 + jnp.einsum("bhqk,bkhd->bhqd", p,
+                              v_blk.astype(jnp.float32)))
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o0 = jnp.zeros((b, h, t, d), jnp.float32)
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    o, m, l, _, _ = jax.lax.fori_loop(0, n_shards, body, (o0, m0, l0, k, v))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = o / l[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, axis="seq", causal=False,
+                           scale=None):
+    """Apply ring attention to globally-shaped ``[b, t, h, d]`` arrays
+    sharded (or shardable) over ``mesh[axis]`` on the time dimension."""
+    spec = PartitionSpec(None, axis, None, None)
+    fn = jax.shard_map(
+        partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
+
+
+def attention_reference(q, k, v, causal=False, scale=None):
+    """Single-device exact attention (correctness oracle for the ring)."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        t_q, t_k = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(t_q)[:, None] >= jnp.arange(t_k)[None, :]
+        s = jnp.where(mask[None, None], s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
