@@ -143,6 +143,11 @@ class Executor:
         arg_vals = tuple(a.data for a in self.arg_arrays)
         aux_vals = tuple(a.data for a in self.aux_arrays)
         key = self._next_key()
+        if arg_vals:
+            try:  # co-locate the key with this executor's device
+                key = jax.device_put(key, list(arg_vals[0].devices())[0])
+            except Exception:
+                pass
 
         if self._monitor is not None:
             def cb(name, val):
@@ -218,17 +223,24 @@ class Executor:
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
+        def _assign(dst, src):
+            val = src.data.astype(dst.dtype)
+            try:  # keep the executor's device placement
+                dev = list(dst.data.devices())[0]
+                val = jax.device_put(val, dev)
+            except Exception:
+                pass
+            dst._set_data(val)
+
         for name, arr in arg_params.items():
             if name in self.arg_dict:
-                self.arg_dict[name]._set_data(
-                    arr.data.astype(self.arg_dict[name].dtype))
+                _assign(self.arg_dict[name], arr)
             elif not allow_extra_params:
                 raise MXNetError("unknown argument %s" % name)
         if aux_params:
             for name, arr in aux_params.items():
                 if name in self.aux_dict:
-                    self.aux_dict[name]._set_data(
-                        arr.data.astype(self.aux_dict[name].dtype))
+                    _assign(self.aux_dict[name], arr)
                 elif not allow_extra_params:
                     raise MXNetError("unknown aux state %s" % name)
 

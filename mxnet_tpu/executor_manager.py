@@ -1,0 +1,80 @@
+"""Executor-manager helpers (reference ``python/mxnet/executor_manager.py``).
+
+``_split_input_slice`` implements the reference's workload split of a batch
+across a context list.  On TPU a "context list" is a view over mesh devices;
+the Module's fused path shards the batch dimension instead of slicing it,
+but the slice math is kept for API/test parity and for CPU-mesh runs.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray import zeros
+from . import ndarray as nd
+
+
+def _split_input_slice(batch_size, work_load_list):
+    """Split a batch into slices proportional to work_load_list
+    (reference ``executor_manager.py:15-41``)."""
+    total_work_load = sum(work_load_list)
+    batch_num_list = [round(work_load * batch_size / total_work_load)
+                      for work_load in work_load_list]
+    batch_num_sum = sum(batch_num_list)
+    if batch_num_sum < batch_size:
+        batch_num_list[-1] += batch_size - batch_num_sum
+    slices = []
+    end = 0
+    for batch_num in batch_num_list:
+        begin = int(min(end, batch_size))
+        end = int(min(begin + batch_num, batch_size))
+        if begin >= end:
+            raise MXNetError("Too many slices. Some splits are empty.")
+        slices.append(slice(begin, end))
+    return slices
+
+
+def _check_arguments(symbol):
+    """Assert no duplicated argument/aux names
+    (reference ``executor_manager.py:44-69``)."""
+    arg_set = set()
+    arg_names = symbol.list_arguments()
+    for name in arg_names:
+        if name in arg_set:
+            raise ValueError("Find duplicated argument name \"%s\"" % name)
+        arg_set.add(name)
+    aux_set = set()
+    for name in symbol.list_auxiliary_states():
+        if name in aux_set:
+            raise ValueError("Find duplicated auxiliary param name \"%s\"" % name)
+        aux_set.add(name)
+
+
+def _load_general(data, targets):
+    """Scatter batch arrays into per-executor slices
+    (reference ``executor_manager.py:72-88``)."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            src = d_src.asnumpy()
+            for slice_idx, d_dst in d_targets:
+                d_dst._sync_copyfrom(src[slice_idx])
+
+
+def _load_data(batch, targets):
+    _load_general(batch.data, targets)
+
+
+def _load_label(batch, targets):
+    _load_general(batch.label, targets)
+
+
+class DataParallelExecutorGroup(object):
+    """Re-exported from module.executor_group for backwards compatibility."""
+
+    def __new__(cls, *args, **kwargs):
+        from .module.executor_group import DataParallelExecutorGroup as G
+        return G(*args, **kwargs)

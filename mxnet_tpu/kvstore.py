@@ -96,7 +96,17 @@ class KVStore(object):
                 raise MXNetError("key %s not initialized" % str(k))
             src = self._stored[k]
             for o in olist:
-                o._set_data(src.data.astype(o.dtype))
+                # place onto the puller's device (CommDevice broadcast analog)
+                import jax
+                dev = None
+                try:
+                    dev = list(o.data.devices())[0]
+                except Exception:
+                    pass
+                val = src.data.astype(o.dtype)
+                if dev is not None:
+                    val = jax.device_put(val, dev)
+                o._set_data(val)
 
     def _merge(self, vlist):
         """Sum values pushed from N logical devices — one fused add-n
@@ -104,10 +114,14 @@ class KVStore(object):
         if len(vlist) == 1:
             merged = vlist[0].copy()
         else:
-            import jax.numpy as jnp
+            import jax
+            # gather shards onto one device then add-n (the reference's
+            # Comm tree-reduce; on a sharded mesh XLA lowers this to an
+            # all-reduce instead)
+            dev = list(vlist[0].data.devices())[0]
             acc = vlist[0].data
             for v in vlist[1:]:
-                acc = acc + v.data
+                acc = acc + jax.device_put(v.data, dev)
             merged = NDArray(acc)
         return merged
 

@@ -1,0 +1,274 @@
+"""Data-parallel executor group.
+
+Reference: ``python/mxnet/module/executor_group.py:77-652``.  The group
+binds one Executor per context, slices each incoming batch across them
+(``decide_slices`` / ``_load_data``), runs forward/backward per slice, and
+exposes merged outputs.  On a TPU mesh the Module's fused Trainer path
+replaces all of this with batch-dim sharding; this group remains the
+semantic reference (and the multi-context CPU path the reference tests
+exercise).
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from ..base import MXNetError, current_context
+from ..executor_manager import _split_input_slice, _load_general
+from ..io import DataDesc
+from ..ndarray import NDArray, zeros, concatenate
+from .. import ndarray as nd
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Concatenate per-executor outputs along the batch axis
+    (reference ``executor_group.py:28-50``)."""
+    rets = []
+    for tensors, axis in zip(outputs, major_axis):
+        if axis >= 0 and len(tensors) > 1:
+            rets.append(concatenate(tensors, axis=axis, always_copy=False))
+        else:
+            rets.append(tensors[0])
+    return rets
+
+
+class DataParallelExecutorGroup(object):
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req="write", state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.logger = logger
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        if not for_training:
+            grad_req = "null"
+        data_names = [x.name if isinstance(x, DataDesc) else x[0]
+                      for x in data_shapes]
+        if isinstance(grad_req, str):
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = "null" if k in self.fixed_param_names \
+                        else grad_req
+                elif k in data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else "null"
+                else:
+                    self.grad_req[k] = "null"
+        elif isinstance(grad_req, (list, tuple)):
+            self.grad_req = dict(zip(self.arg_names, grad_req))
+        elif isinstance(grad_req, dict):
+            self.grad_req = {k: "null" for k in self.arg_names}
+            self.grad_req.update(grad_req)
+        else:
+            raise MXNetError("invalid grad_req")
+        self.execs = []
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_arrays = None
+        self.label_arrays = None
+        self.param_arrays = None
+        self.grad_arrays = None
+        self.aux_arrays = None
+        self.slices = None
+        self.batch_size = None
+        self.shared_group = shared_group
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    # ------------------------------------------------------------------
+    def decide_slices(self, data_shapes):
+        """Workload-proportional batch slices
+        (reference ``executor_group.py:207-236``)."""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(x, "layout", "NCHW"))
+                      for x in data_shapes]
+        for (name, shape), axis in zip(data_shapes, major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, \
+                    ("all data must have the same batch size: "
+                     + ("batch_size = %d, but " % self.batch_size)
+                     + ("%s has shape %s" % (name, shape)))
+            else:
+                self.batch_size = batch_size
+                self.slices = _split_input_slice(self.batch_size, self.workload)
+        return major_axis
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None and len(label_shapes) > 0:
+            self.label_layouts = self.decide_slices(label_shapes)
+        else:
+            self.label_layouts = []
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes,
+                                                  shared_group))
+        # convenient per-parameter views shared across executors
+        self.data_arrays = [[(self.slices[i], e.arg_dict[name])
+                             for i, e in enumerate(self.execs)]
+                            for name, _ in [(x.name if isinstance(x, DataDesc)
+                                             else x[0], x)
+                                            for x in data_shapes]]
+        if label_shapes is not None:
+            self.label_arrays = [[(self.slices[i], e.arg_dict[name])
+                                  for i, e in enumerate(self.execs)]
+                                 for name in [x.name if isinstance(x, DataDesc)
+                                              else x[0]
+                                              for x in label_shapes]]
+        else:
+            self.label_arrays = None
+        self.param_arrays = [[e.arg_dict[name] for e in self.execs]
+                             for name in self.param_names]
+        self.grad_arrays = [[e.grad_dict.get(name) for e in self.execs]
+                            for name in self.param_names
+                            if self.grad_req.get(name, "null") != "null"]
+        # keep index alignment with param_arrays (reference keeps both lists
+        # parallel; grads for null-req params are None)
+        self.grad_arrays = [[e.grad_dict.get(name) for e in self.execs]
+                            for name in self.param_names]
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
+                           for name in self.aux_names]
+        self.input_grad_arrays = None
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [[e.grad_dict.get(x.name if isinstance(x, DataDesc) else x[0])
+                                       for e in self.execs]
+                                      for x in data_shapes]
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        sliced = []
+        for (desc, axis) in zip(shapes, major_axis):
+            name = desc.name if isinstance(desc, DataDesc) else desc[0]
+            shape = list(desc.shape if isinstance(desc, DataDesc) else desc[1])
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced.append(DataDesc(name, tuple(shape),
+                                   getattr(desc, "dtype", np.float32)))
+        return sliced
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        data_shapes_i = self._sliced_shape(data_shapes, i, self.data_layouts)
+        if label_shapes is not None and len(label_shapes):
+            label_shapes_i = self._sliced_shape(label_shapes, i,
+                                                self.label_layouts)
+        else:
+            label_shapes_i = []
+        ctx = self.contexts[i]
+        input_shapes = {x.name: x.shape for x in data_shapes_i}
+        input_shapes.update({x.name: x.shape for x in label_shapes_i})
+        input_types = {x.name: x.dtype for x in data_shapes_i}
+        input_types.update({x.name: x.dtype for x in label_shapes_i})
+        shared_exec = shared_group.execs[i] if shared_group is not None else None
+        executor = self.symbol.simple_bind(
+            ctx=ctx, grad_req=self.grad_req, type_dict=input_types,
+            shared_exec=shared_exec, **input_shapes)
+        return executor
+
+    # ------------------------------------------------------------------
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and label_shapes == self.label_shapes:
+            return
+        self.batch_size = None
+        arg_params = {}
+        aux_params = {}
+        if self.execs:
+            arg_params = {n: self.execs[0].arg_dict[n]
+                          for n in self.param_names}
+            aux_params = dict(self.execs[0].aux_dict)
+        self.bind_exec(data_shapes, label_shapes, self.shared_group)
+        if arg_params:
+            self.set_params(arg_params, aux_params)
+
+    def set_params(self, arg_params, aux_params):
+        for texec in self.execs:
+            texec.copy_params_from(arg_params, aux_params,
+                                   allow_extra_params=True)
+
+    def get_params(self, arg_params, aux_params):
+        """Average parameters across executors into the given dicts
+        (reference ``executor_group.py:337-354``)."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = sum(w.copyto(current_context()) for w in block) / len(block)
+            weight.astype(arg_params[name].dtype).copyto(arg_params[name])
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            weight = sum(w.copyto(current_context()) for w in block) / len(block)
+            weight.astype(aux_params[name].dtype).copyto(aux_params[name])
+
+    def forward(self, data_batch, is_train=None):
+        _load_general(data_batch.data, self.data_arrays)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_arrays is not None and data_batch.label is not None \
+                and len(data_batch.label):
+            _load_general(data_batch.label, self.label_arrays)
+        for texec in self.execs:
+            texec.forward(is_train=is_train)
+
+    def get_output_shapes(self):
+        outputs = self.execs[0].outputs
+        shapes = [out.shape for out in outputs]
+        concat_shapes = []
+        for key, the_shape, axis in zip(self.symbol.list_outputs(), shapes,
+                                        self.output_layouts):
+            the_shape = list(the_shape)
+            if axis >= 0:
+                the_shape[axis] = self.batch_size
+            concat_shapes.append((key, tuple(the_shape)))
+        return concat_shapes
+
+    @property
+    def output_layouts(self):
+        return [0] * len(self.symbol.list_outputs())
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[exec_.outputs[i] for exec_ in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            outputs = _merge_multi_context(outputs, self.output_layouts)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            return _merge_multi_context(self.input_grad_arrays,
+                                        self.data_layouts)
+        return self.input_grad_arrays
+
+    def backward(self, out_grads=None):
+        assert self.for_training, "re-bind with for_training=True to run backward"
+        if out_grads is None:
+            out_grads = []
+        elif isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        for i, exec_ in enumerate(self.execs):
+            out_grads_slice = []
+            for grad, axis in zip(out_grads, self.output_layouts):
+                if axis >= 0:
+                    og = NDArray(grad.data[self.slices[i]]) \
+                        if axis == 0 else grad
+                    out_grads_slice.append(og)
+                else:
+                    out_grads_slice.append(grad)
+            exec_.backward(out_grads=out_grads_slice if out_grads_slice else None)
+
+    def update_metric(self, eval_metric, labels):
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = [NDArray(label.data[islice]) for label in labels]
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def install_monitor(self, mon):
+        for exe in self.execs:
+            exe.install_monitor(mon)

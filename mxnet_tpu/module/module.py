@@ -1,0 +1,499 @@
+"""Module: the primary training surface.
+
+Reference: ``python/mxnet/module/module.py:323-565``.  Two execution paths:
+
+* **classic** (``context`` = Context or list): one Executor per context via
+  :class:`DataParallelExecutorGroup`, gradients synced through KVStore /
+  local Updater — semantics identical to the reference, used by the parity
+  tests.
+* **fused** (``context`` = a ``jax.sharding.Mesh``): forward+backward+
+  allreduce+update compile into ONE XLA computation
+  (:class:`mxnet_tpu.parallel.Trainer`), batch sharded over the mesh's
+  ``data`` axis.  This is the TPU-performance path (BASELINE north star:
+  the whole train step is a single pjit'd program).  ``forward(is_train=
+  True)`` stages the batch; ``update()`` executes the fused step; outputs
+  seen by metrics are the pre-update forward outputs, matching reference
+  timing.
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+
+import numpy as np
+
+from .. import ndarray
+from .. import optimizer as opt
+from ..base import Context, MXNetError, current_context
+from ..initializer import Uniform, InitDesc
+from ..io import DataDesc
+from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
+                     _update_params_on_kvstore, load_checkpoint,
+                     save_checkpoint)
+from ..ndarray import NDArray, zeros
+from .base_module import BaseModule, _check_input_names
+from .executor_group import DataParallelExecutorGroup
+
+try:
+    from jax.sharding import Mesh as _JaxMesh
+except Exception:  # pragma: no cover
+    _JaxMesh = ()
+
+
+class Module(BaseModule):
+    """Module over a Symbol (reference ``module.py:31-90``)."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging,
+                 context=None, work_load_list=None, fixed_param_names=None):
+        super().__init__(logger=logger)
+        if context is None:
+            context = current_context()
+        self._mesh = context if isinstance(context, _JaxMesh) else None
+        if self._mesh is not None:
+            self._context = [current_context()]
+        elif isinstance(context, Context):
+            self._context = [context]
+        else:
+            self._context = list(context)
+        if work_load_list is None:
+            work_load_list = [1] * len(self._context)
+        assert len(work_load_list) == len(self._context)
+        self._work_load_list = work_load_list
+
+        self._symbol = symbol
+        data_names = list(data_names) if data_names is not None else []
+        label_names = list(label_names) if label_names is not None else []
+        arg_names = symbol.list_arguments()
+        input_names = data_names + label_names
+        self._param_names = [x for x in arg_names if x not in input_names]
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._aux_names = symbol.list_auxiliary_states()
+        self._data_names = data_names
+        self._label_names = label_names
+        self._state_names = []
+        self._output_names = symbol.list_outputs()
+        _check_input_names(symbol, data_names, "data", True)
+        _check_input_names(symbol, label_names, "label", False)
+        _check_input_names(symbol, self._fixed_param_names, "fixed_param", True)
+
+        self._arg_params = None
+        self._aux_params = None
+        self._params_dirty = False
+        self._optimizer = None
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._updater = None
+        self._preload_opt_states = None
+        self._exec_group = None
+        self._data_shapes = None
+        self._label_shapes = None
+        # fused path state
+        self._trainer = None
+        self._staged_batch = None
+        self._fused_outputs = None
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        """Create a Module from a checkpoint (reference ``module.py:104``)."""
+        sym, args, auxs = load_checkpoint(prefix, epoch)
+        mod = Module(symbol=sym, **kwargs)
+        mod._arg_params = args
+        mod._aux_params = auxs
+        mod.params_initialized = True
+        if load_optimizer_states:
+            mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
+        return mod
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        """Checkpoint symbol + params (+ optimizer states)
+        (reference ``module.py:129``)."""
+        self._symbol.save("%s-symbol.json" % prefix)
+        param_name = "%s-%04d.params" % (prefix, epoch)
+        self.save_params(param_name)
+        logging.info("Saved checkpoint to \"%s\"", param_name)
+        if save_optimizer_states:
+            state_name = "%s-%04d.states" % (prefix, epoch)
+            self.save_optimizer_states(state_name)
+            logging.info("Saved optimizer state to \"%s\"", state_name)
+
+    # ------------------------------------------------------------------
+    def _reset_bind(self):
+        self.binded = False
+        self._exec_group = None
+        self._data_shapes = None
+        self._label_shapes = None
+        self._trainer = None
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def label_names(self):
+        return self._label_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        assert self.binded
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        assert self.binded
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        assert self.binded
+        if self._exec_group is not None:
+            return self._exec_group.get_output_shapes()
+        shapes = {n: s.shape for n, s in
+                  (self._data_shapes + (self._label_shapes or []))}
+        _, out_shapes, _ = self._symbol.infer_shape(**shapes)
+        return list(zip(self._output_names, out_shapes))
+
+    # ------------------------------------------------------------------
+    def get_params(self):
+        assert self.binded and self.params_initialized
+        if self._params_dirty:
+            self._sync_params_from_devices()
+        return (self._arg_params, self._aux_params)
+
+    def init_params(self, initializer=Uniform(0.01), arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False):
+        """Initialize parameters (reference ``module.py:173-235``)."""
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and force_init=False. "
+                          "init_params call ignored.", stacklevel=2)
+            return
+        assert self.binded, "call bind before initializing the parameters"
+
+        def _impl(name, arr, cache):
+            if cache is not None:
+                if name in cache:
+                    cache_arr = cache[name]
+                    if cache_arr is not arr:
+                        cache_arr.copyto(arr)
+                else:
+                    if not allow_missing:
+                        raise RuntimeError("%s is not presented" % name)
+                    if initializer is not None:
+                        initializer(name, arr)
+            else:
+                initializer(name, arr)
+
+        attrs = self._symbol.attr_dict()
+        for name, arr in sorted(self._arg_params.items()):
+            desc = InitDesc(name, attrs.get(name, None))
+            _impl(desc, arr, arg_params)
+        for name, arr in sorted(self._aux_params.items()):
+            desc = InitDesc(name, attrs.get(name, None))
+            _impl(desc, arr, aux_params)
+
+        self.params_initialized = True
+        self._params_dirty = False
+        if self._trainer is not None:
+            self._trainer.init_params(arg_params=self._arg_params,
+                                      aux_params=self._aux_params,
+                                      force_init=True)
+        elif self._exec_group is not None:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+        # else: fused path before init_optimizer — host mirrors are pushed
+        # into the Trainer when it is created
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True):
+        if not allow_missing:
+            self.init_params(initializer=None, arg_params=arg_params,
+                             aux_params=aux_params, allow_missing=allow_missing,
+                             force_init=force_init)
+            return
+        if self.params_initialized and not force_init:
+            warnings.warn("Parameters already initialized and force_init=False. "
+                          "set_params call ignored.", stacklevel=2)
+            return
+        if self._trainer is not None:
+            self._trainer.set_params(arg_params, aux_params)
+        else:
+            self._exec_group.set_params(arg_params, aux_params)
+        self._params_dirty = True
+        self.params_initialized = True
+
+    # ------------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        """Bind executors (reference ``module.py:323-431``)."""
+        if force_rebind:
+            self._reset_bind()
+        if self.binded:
+            self.logger.warning("Already binded, ignoring bind()")
+            return
+
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.binded = True
+
+        if not for_training:
+            assert not inputs_need_grad
+
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        if label_shapes is not None and len(label_shapes):
+            self._label_shapes = [x if isinstance(x, DataDesc)
+                                  else DataDesc(*x) for x in label_shapes]
+        else:
+            self._label_shapes = None
+
+        if shared_module is not None:
+            assert isinstance(shared_module, Module) and \
+                shared_module.binded and shared_module.params_initialized
+            shared_group = shared_module._exec_group
+        else:
+            shared_group = None
+
+        if self._mesh is not None and for_training and not inputs_need_grad \
+                and shared_module is None:
+            # fused path defers compilation until init_optimizer; here we
+            # only infer shapes and allocate host-visible param mirrors
+            self._build_param_mirrors()
+            return
+
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list,
+            self._data_shapes, self._label_shapes, self._param_names,
+            for_training, inputs_need_grad, shared_group, logger=self.logger,
+            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        if shared_module is not None:
+            self.params_initialized = True
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+        elif self.params_initialized:
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+        else:
+            assert self._arg_params is None and self._aux_params is None
+            param_arrays = [zeros(x[0].shape, dtype=x[0].dtype)
+                            for x in self._exec_group.param_arrays]
+            self._arg_params = dict(zip(self._param_names, param_arrays))
+            aux_arrays = [zeros(x[0].shape, dtype=x[0].dtype)
+                          for x in self._exec_group.aux_arrays]
+            self._aux_params = dict(zip(self._aux_names, aux_arrays))
+        if shared_module is not None and shared_module.optimizer_initialized:
+            self.borrow_optimizer(shared_module)
+
+    def _build_param_mirrors(self):
+        shapes = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            shapes.update({d.name: d.shape for d in self._label_shapes})
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**shapes)
+        arg_types, _, aux_types = self._symbol.infer_type()
+        arg_map = dict(zip(self._symbol.list_arguments(), arg_shapes))
+        aux_map = dict(zip(self._aux_names, aux_shapes))
+        if self._arg_params is None:
+            self._arg_params = {n: zeros(arg_map[n]) for n in self._param_names}
+            self._aux_params = {n: zeros(aux_map[n]) for n in self._aux_names}
+
+    def reshape(self, data_shapes, label_shapes=None):
+        """Reshape the module for new batch shapes
+        (reference ``module.py:433``)."""
+        assert self.binded
+        self._data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
+                             for x in data_shapes]
+        if label_shapes is not None and len(label_shapes):
+            self._label_shapes = [x if isinstance(x, DataDesc)
+                                  else DataDesc(*x) for x in label_shapes]
+        else:
+            self._label_shapes = None
+        if self._exec_group is not None:
+            self._exec_group.reshape(self._data_shapes, self._label_shapes)
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """Install optimizer + kvstore (reference ``module.py:432-530``)."""
+        assert self.binded and self.params_initialized
+        if self.optimizer_initialized and not force_init:
+            self.logger.warning("optimizer already initialized, ignoring...")
+            return
+
+        if isinstance(optimizer, str):
+            batch_size = self._data_shapes[0].shape[0]
+            idx2name = {i: n for i, n in enumerate(self._param_names)}
+            optimizer_params = dict(optimizer_params)
+            if "rescale_grad" not in optimizer_params:
+                optimizer_params["rescale_grad"] = 1.0 / batch_size
+            optimizer = opt.create(optimizer, sym=self.symbol,
+                                   param_idx2name=idx2name, **optimizer_params)
+        else:
+            assert isinstance(optimizer, opt.Optimizer)
+            if not optimizer.idx2name:
+                optimizer.idx2name = {i: n for i, n in
+                                      enumerate(self._param_names)}
+
+        self._optimizer = optimizer
+
+        if self._mesh is not None and self._exec_group is None:
+            from ..parallel.trainer import Trainer
+            self._trainer = Trainer(
+                self._symbol, optimizer, data_names=self._data_names,
+                label_names=self._label_names, mesh=self._mesh)
+            self._trainer.bind(
+                data_shapes={d.name: d.shape for d in self._data_shapes},
+                label_shapes={d.name: d.shape
+                              for d in (self._label_shapes or [])})
+            self._trainer.init_params(arg_params=self._arg_params,
+                                      aux_params=self._aux_params,
+                                      force_init=True)
+            self._kvstore = None
+            self._update_on_kvstore = False
+            self.optimizer_initialized = True
+            return
+
+        kvstore, update_on_kvstore = _create_kvstore(
+            kvstore, len(self._context), self._arg_params)
+        self._kvstore = kvstore
+        self._update_on_kvstore = update_on_kvstore
+        self._updater = None
+
+        if kvstore:
+            _initialize_kvstore(kvstore=kvstore,
+                                param_arrays=self._exec_group.param_arrays,
+                                arg_params=self._arg_params,
+                                param_names=self._param_names,
+                                update_on_kvstore=update_on_kvstore)
+        if update_on_kvstore:
+            kvstore.set_optimizer(self._optimizer)
+        else:
+            self._updater = opt.get_updater(optimizer)
+
+        self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
+
+    def borrow_optimizer(self, shared_module):
+        """Borrow optimizer from a shared module
+        (reference ``module.py:531``)."""
+        assert shared_module.optimizer_initialized
+        self._optimizer = shared_module._optimizer
+        self._kvstore = shared_module._kvstore
+        self._update_on_kvstore = shared_module._update_on_kvstore
+        self._updater = shared_module._updater
+        self.optimizer_initialized = True
+
+    # ------------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        assert self.binded and self.params_initialized
+        if self._trainer is not None or (self._mesh is not None and
+                                         self._exec_group is None):
+            if is_train is None:
+                is_train = self.for_training
+            batch = self._fused_batch_dict(data_batch)
+            if is_train:
+                self._staged_batch = batch
+                self._fused_outputs = None
+            else:
+                self._fused_outputs = self._trainer.forward(batch)
+            return
+        self._exec_group.forward(data_batch, is_train)
+
+    def _fused_batch_dict(self, data_batch):
+        batch = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            batch[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                batch[name] = arr
+        return batch
+
+    def backward(self, out_grads=None):
+        assert self.binded and self.params_initialized
+        if self._trainer is not None:
+            assert out_grads is None, \
+                "fused mesh path computes gradients internally"
+            return
+        self._exec_group.backward(out_grads=out_grads)
+
+    def update(self):
+        """Apply the optimizer (reference ``module.py:553``)."""
+        assert self.binded and self.params_initialized and \
+            self.optimizer_initialized
+        self._params_dirty = True
+        if self._trainer is not None:
+            assert self._staged_batch is not None, \
+                "call forward(is_train=True) before update() on the fused path"
+            self._fused_outputs = self._trainer.step(self._staged_batch)
+            self._staged_batch = None
+            return
+        if self._update_on_kvstore:
+            _update_params_on_kvstore(self._exec_group.param_arrays,
+                                      self._exec_group.grad_arrays,
+                                      self._kvstore)
+        else:
+            _update_params(self._exec_group.param_arrays,
+                           self._exec_group.grad_arrays,
+                           updater=self._updater,
+                           num_device=len(self._context),
+                           kvstore=self._kvstore)
+
+    def get_outputs(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized
+        if self._trainer is not None:
+            assert self._fused_outputs is not None, \
+                "no outputs yet: run forward(is_train=False) or update()"
+            return self._fused_outputs
+        return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.binded and self.params_initialized and self.inputs_need_grad
+        return self._exec_group.get_input_grads(
+            merge_multi_context=merge_multi_context)
+
+    def update_metric(self, eval_metric, labels):
+        if self._trainer is not None:
+            if self._fused_outputs is not None:
+                eval_metric.update(labels, self._fused_outputs)
+            return
+        self._exec_group.update_metric(eval_metric, labels)
+
+    # ------------------------------------------------------------------
+    def _sync_params_from_devices(self):
+        if self._trainer is not None:
+            arg, aux = self._trainer.get_params()
+            for n, v in arg.items():
+                self._arg_params[n]._set_data(v.data)
+            for n, v in aux.items():
+                self._aux_params[n]._set_data(v.data)
+        else:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
+        self._params_dirty = False
+
+    def save_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname)
+        elif self._updater is not None:
+            with open(fname, "wb") as fout:
+                fout.write(self._updater.get_states())
+        else:
+            raise MXNetError("fused-path optimizer state save not yet "
+                             "supported; use the classic context path")
+
+    def load_optimizer_states(self, fname):
+        assert self.optimizer_initialized
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+        else:
+            with open(fname, "rb") as fin:
+                self._updater.set_states(fin.read())
+
+    def install_monitor(self, mon):
+        assert self.binded
+        if self._exec_group is not None:
+            self._exec_group.install_monitor(mon)

@@ -510,7 +510,8 @@ def _infer_graph(sym: Symbol, known: Dict[str, Any], partial: bool, what: str):
         for (c, ci), new_v in zip(n.inputs, in_s):
             if c.is_variable and new_v is not None:
                 prev = var_vals.get(c.name)
-                if prev is not None and tuple(prev) != tuple(new_v) and what == "shape":
+                if what == "shape" and prev is not None \
+                        and tuple(prev) != tuple(new_v):
                     raise MXNetError(
                         "shape mismatch for %s: %s vs %s" % (c.name, prev, new_v))
                 var_vals[c.name] = tuple(new_v) if what == "shape" else new_v
