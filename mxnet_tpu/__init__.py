@@ -55,33 +55,34 @@ _populate_namespaces()
 for _sampler in ("uniform", "normal"):
     setattr(random, _sampler, getattr(ndarray, _sampler))
 
-try:
-    from . import initializer
-    from . import initializer as init
-    from . import optimizer
-    from .optimizer import Optimizer
-    from . import lr_scheduler
-    from . import metric
-    from . import callback
-    from . import io
-    from . import recordio
-    from . import kvstore
-    from . import kvstore as kv
-    from . import module
-    from . import module as mod
-    from .module import Module
-    from . import monitor
-    from .monitor import Monitor
-    from . import test_utils
-    from . import visualization
-    from . import visualization as viz
-    from . import rnn
-    from . import model
-    from .model import FeedForward
-    from .executor_manager import DataParallelExecutorGroup  # noqa: F401
-    from . import profiler
-    from . import operator
-    from .operator import CustomOp, CustomOpProp
-    from . import parallel
-except ImportError:  # pragma: no cover - bootstrap guard, removed once complete
-    pass
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import module
+from . import module as mod
+from .module import Module
+from . import monitor
+from .monitor import Monitor
+from . import test_utils
+from . import visualization
+from . import visualization as viz
+from . import rnn
+from . import model
+from .model import FeedForward
+from .executor_manager import DataParallelExecutorGroup  # noqa: F401
+from . import profiler
+from . import operator
+from .operator import CustomOp, CustomOpProp
+from . import parallel
+
+# Custom op front-ends (reference mx.nd.Custom / mx.sym.Custom)
+ndarray.Custom = operator._custom_entry("nd")
+symbol.Custom = operator._custom_entry("sym")

@@ -161,9 +161,18 @@ def tpu(device_id=0):
 
 def default_context() -> Context:
     """Framework default: the accelerator if present, else CPU."""
+    override = getattr(Context._default_ctx, "value", None)
+    if override is not None:
+        return override
     if _accelerator_devices():
         return Context("tpu", 0)
     return Context("cpu", 0)
+
+
+def set_default_context(ctx: Context):
+    """Set the process default context (reference
+    ``test_utils.py:34`` set_default_context)."""
+    Context._default_ctx.value = ctx
 
 
 def current_context() -> Context:

@@ -149,6 +149,7 @@ class Executor:
             except Exception:
                 pass
 
+        from . import profiler as _prof
         if self._monitor is not None:
             def cb(name, val):
                 self._monitor(name, NDArray(val))
@@ -156,13 +157,15 @@ class Executor:
                 list(arg_vals), list(aux_vals), key, is_train, monitor=cb)
             self._vjp = None
         elif is_train:
-            fn = self._prog.jitted(True)
-            (outs, new_aux), vjp = jax.vjp(
-                lambda a, x: fn(a, x, key), arg_vals, aux_vals)
+            with _prof.record_scope("Forward", str(self._ctx)):
+                fn = self._prog.jitted(True)
+                (outs, new_aux), vjp = jax.vjp(
+                    lambda a, x: fn(a, x, key), arg_vals, aux_vals)
             self._vjp = vjp
         else:
-            fn = self._prog.jitted(False)
-            outs, new_aux = fn(arg_vals, aux_vals, key)
+            with _prof.record_scope("Forward", str(self._ctx)):
+                fn = self._prog.jitted(False)
+                outs, new_aux = fn(arg_vals, aux_vals, key)
             self._vjp = None
         for arr, v in zip(self.aux_arrays, new_aux):
             arr._set_data(v)
@@ -185,7 +188,9 @@ class Executor:
             else:
                 cotangents.append(jnp.ones(o.shape, o.dtype))
         aux_cot = tuple(jnp.zeros(a.shape, a.dtype) for a in self.aux_arrays)
-        arg_grads, _aux_grads = self._vjp((tuple(cotangents), aux_cot))
+        from . import profiler as _prof
+        with _prof.record_scope("Backward", str(self._ctx)):
+            arg_grads, _aux_grads = self._vjp((tuple(cotangents), aux_cot))
         for name, arr, g in zip(self._prog.arg_names, self.grad_arrays,
                                 arg_grads):
             req = self.grad_req.get(name, "null")

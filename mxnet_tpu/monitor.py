@@ -1,0 +1,92 @@
+"""Monitor: per-op output statistics tap.
+
+Reference: ``python/mxnet/monitor.py:16-126`` wired through the executor
+monitor callback (``graph_executor.cc:757-778``).  Installing a monitor
+switches the executor to per-node (uncompiled) evaluation — the same
+performance cliff as the reference disabling bulk exec — so stats can be
+pulled after every op for NaN-hunting.
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+from .ndarray import NDArray
+from . import ndarray
+
+
+class Monitor(object):
+    """Monitor outputs, weights and gradients for debugging.
+
+    Parameters mirror the reference: ``interval`` batches between stat
+    collection, ``stat_func`` maps NDArray -> NDArray stat (default
+    mean(abs(x))), ``pattern`` regex selects which tensors to watch.
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        if stat_func is None:
+            def asum_stat(x):
+                return ndarray.norm(x) / (x.size ** 0.5)
+            stat_func = asum_stat
+        self.stat_func = stat_func
+        self.interval = interval
+        self.activated = False
+        self.queue = []
+        self.step = 0
+        self.exes = []
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+
+        def stat_helper(name, array):
+            if not self.activated or not self.re_prog.match(name):
+                return
+            self.queue.append((self.step, name, self.stat_func(array)))
+
+        self.stat_helper = stat_helper
+
+    def install(self, exe):
+        """Install the tap on an executor (reference ``monitor.py:56``)."""
+        exe.install_monitor(self.stat_helper)
+        self.exes.append(exe)
+
+    def tic(self):
+        """Start collecting stats for this batch if due
+        (reference ``monitor.py:68``)."""
+        if self.step % self.interval == 0:
+            for exe in self.exes:
+                for array in exe.arg_arrays:
+                    array.wait_to_read()
+            self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Finish collecting; returns [(step, name, stat_str)]
+        (reference ``monitor.py:82``)."""
+        if not self.activated:
+            return []
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+        for exe in self.exes:
+            for name, array in exe.arg_dict.items():
+                if self.re_prog.match(name):
+                    self.queue.append((self.step, name, self.stat_func(array)))
+        self.activated = False
+        res = []
+        if self.sort:
+            self.queue.sort(key=lambda x: x[1])
+        for n, k, v_list in self.queue:
+            if isinstance(v_list, NDArray):
+                v_list = [v_list]
+            assert isinstance(v_list, list)
+            s = ",".join("%f" % v.asnumpy().reshape(-1)[0] for v in v_list)
+            res.append((n, k, s))
+        self.queue = []
+        return res
+
+    def toc_print(self):
+        """Collect and log stats (reference ``monitor.py:122``)."""
+        res = self.toc()
+        for n, k, v in res:
+            logging.info("Batch: %7d %30s %s", n, k, v)

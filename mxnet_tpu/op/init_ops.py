@@ -22,13 +22,25 @@ def _creation_spec():
             Param("dtype", "dtype", np.dtype(np.float32)))
 
 
-register("_zeros", lambda p, c: jnp.zeros(p["shape"], p["dtype"]),
+def _concrete(shape):
+    """MXNet shape semantics: dim 0 = unknown.  Creation ops materialize
+    unknown dims as broadcastable size-1 (RNN ``begin_state`` zeros with
+    shape ``(0, h)`` combine with real activations via broadcasting — the
+    jit-friendly stand-in for the reference's bidirectional shape infer)."""
+    return tuple(1 if d == 0 else d for d in shape)
+
+
+register("_zeros", lambda p, c: jnp.zeros(_concrete(p["shape"]), p["dtype"]),
          params_spec=_creation_spec(), input_names=())
-register("_ones", lambda p, c: jnp.ones(p["shape"], p["dtype"]),
+register("_ones", lambda p, c: jnp.ones(_concrete(p["shape"]), p["dtype"]),
          params_spec=_creation_spec(), input_names=())
-register("_full", lambda p, c: jnp.full(p["shape"], p["value"], p["dtype"]),
+register("_full", lambda p, c: jnp.full(_concrete(p["shape"]), p["value"],
+                                        p["dtype"]),
          params_spec=_creation_spec() + (Param("value", float, required=True),),
          input_names=())
+alias("zeros", "_zeros")
+alias("ones", "_ones")
+alias("full", "_full")
 
 
 @register("_arange", params_spec=(Param("start", float, 0.0),

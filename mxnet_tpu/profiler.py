@@ -1,0 +1,105 @@
+"""Profiler (reference ``python/mxnet/profiler.py`` + engine profiler
+``src/engine/profiler.{h,cc}``).
+
+Two layers, matching the reference contract:
+
+* **Framework events** — executor forward/backward and imperative op
+  dispatches are recorded with microsecond wall times and dumped as
+  **Chrome tracing JSON** (the reference's ``Profiler::DumpProfile``
+  format, ``profiler.cc:134-175``: one pid row per device, ``ph: B/E``
+  event pairs), so existing trace-viewing workflows keep working.
+* **Device profiling** — ``profiler_set_state('run')`` also starts the JAX
+  profiler (XPlane) when a trace dir is configured, capturing real TPU
+  timelines; this is the XLA-native layer the reference cannot see.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+_LOCK = threading.Lock()
+_STATE = {"mode": "symbolic", "filename": "profile.json", "running": False,
+          "events": [], "jax_trace_dir": None}
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    """Configure what to profile (reference ``profiler.py:10``):
+    mode 'symbolic' records executor-level ops, 'all' also records
+    imperative calls."""
+    with _LOCK:
+        _STATE["mode"] = mode
+        _STATE["filename"] = filename
+
+
+def profiler_set_state(state="stop"):
+    """'run' or 'stop' (reference ``profiler.py:30``)."""
+    with _LOCK:
+        was = _STATE["running"]
+        _STATE["running"] = (state == "run")
+        if state == "run" and not was:
+            _STATE["events"] = []
+            if _STATE["jax_trace_dir"]:
+                import jax
+                jax.profiler.start_trace(_STATE["jax_trace_dir"])
+        elif state == "stop" and was:
+            if _STATE["jax_trace_dir"]:
+                import jax
+                jax.profiler.stop_trace()
+
+
+def set_jax_trace_dir(path):
+    """Enable the XPlane device trace alongside the Chrome JSON dump."""
+    _STATE["jax_trace_dir"] = path
+
+
+def is_running():
+    return _STATE["running"]
+
+
+def record(name, start_us, end_us, device="tpu/0", category="operator"):
+    """Append one op event (called by the executor / dispatcher)."""
+    if not _STATE["running"]:
+        return
+    with _LOCK:
+        _STATE["events"].append((name, start_us, end_us, device, category))
+
+
+class record_scope:
+    """Context manager timing one region into the profile."""
+
+    def __init__(self, name, device="tpu/0"):
+        self.name = name
+        self.device = device
+
+    def __enter__(self):
+        self.start = time.perf_counter_ns() // 1000
+        return self
+
+    def __exit__(self, *exc):
+        if _STATE["running"]:
+            record(self.name, self.start, time.perf_counter_ns() // 1000,
+                   self.device)
+
+
+def dump_profile():
+    """Write Chrome tracing JSON (reference ``MXDumpProfile`` →
+    ``Profiler::DumpProfile`` format)."""
+    with _LOCK:
+        events = list(_STATE["events"])
+        fname = _STATE["filename"]
+    devices = sorted({e[3] for e in events})
+    pid_of = {d: i for i, d in enumerate(devices)}
+    out = []
+    for d, pid in pid_of.items():
+        out.append({"ph": "M", "args": {"name": d}, "pid": pid,
+                    "name": "process_name"})
+    for name, start_us, end_us, device, category in events:
+        pid = pid_of[device]
+        out.append({"name": name, "cat": category, "ph": "B",
+                    "ts": start_us, "pid": pid, "tid": pid})
+        out.append({"name": name, "cat": category, "ph": "E",
+                    "ts": end_us, "pid": pid, "tid": pid})
+    with open(fname, "w") as f:
+        json.dump({"traceEvents": out}, f, indent=2)
+    return fname

@@ -1,0 +1,14 @@
+"""RNN cell library (reference ``python/mxnet/rnn/``)."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, DropoutCell,
+                       ModifierCell, ZoneoutCell, ResidualCell,
+                       BidirectionalCell)
+from .rnn import (rnn_unroll, save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)
+from .io import encode_sentences, BucketSentenceIter
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ZoneoutCell", "ResidualCell", "BidirectionalCell", "rnn_unroll",
+           "save_rnn_checkpoint", "load_rnn_checkpoint", "do_rnn_checkpoint",
+           "encode_sentences", "BucketSentenceIter"]

@@ -1,0 +1,193 @@
+"""Network visualization (reference ``python/mxnet/visualization.py``).
+
+``plot_network`` renders the symbol graph with graphviz when available;
+``print_summary`` prints a per-layer table with output shapes and
+parameter counts.
+"""
+from __future__ import annotations
+
+import json
+
+from .base import MXNetError
+from .symbol import Symbol
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    """Print a layer summary table (reference ``visualization.py:22``)."""
+    if positions is None:
+        positions = [.44, .64, .74, 1.]
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be Symbol")
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**dict(shape))
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    heads = {x[0] for x in conf["heads"]}
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ["Layer (type)", "Output Shape", "Param #",
+                  "Previous Layer"]
+
+    def print_row(fields, positions_):
+        line = ""
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions_[i]]
+            line += " " * (positions_[i] - len(line))
+        print(line)
+
+    print("_" * line_length)
+    print_row(to_display, positions)
+    print("=" * line_length)
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node["op"]
+        pre_node = []
+        pre_filter = 0
+        if op != "null":
+            inputs = node["inputs"]
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node["name"]
+                if input_node["op"] != "null" or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name + "_output" if input_node["op"] != "null" \
+                            else input_name
+                        if key in shape_dict:
+                            pre_filter = pre_filter + int(shape_dict[key][1]) \
+                                if len(shape_dict[key]) > 1 else pre_filter
+        cur_param = 0
+        attrs = node.get("attrs", {})
+        if op == "Convolution":
+            num_filter = int(attrs["num_filter"])
+            kernel = eval(attrs["kernel"])  # noqa: S307 - trusted json attr
+            cur_param = pre_filter * num_filter
+            for k in kernel:
+                cur_param *= k
+            cur_param += num_filter
+        elif op == "FullyConnected":
+            num_hidden = int(attrs["num_hidden"])
+            cur_param = pre_filter * num_hidden + num_hidden
+        elif op == "BatchNorm":
+            cur_param = pre_filter * 4
+        first_connection = "" if not pre_node else pre_node[0]
+        fields = ["%s(%s)" % (node["name"], op),
+                  "x".join([str(x) for x in out_shape]),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        for i in range(1, len(pre_node)):
+            fields = ["", "", "", pre_node[i]]
+            print_row(fields, positions)
+        total_params[0] += cur_param
+
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node["op"]
+        if op == "null" and i > 0:
+            continue
+        if op != "null" or i in heads:
+            if show_shape:
+                key = node["name"] + "_output" if op != "null" else node["name"]
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        print_layer_summary(node, out_shape)
+        print("_" * line_length)
+    print("Total params: %s" % total_params[0])
+    print("_" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Graphviz plot of the symbol graph (reference
+    ``visualization.py:115``).  Requires the optional graphviz package."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError("plot_network requires the graphviz python package")
+    if not isinstance(symbol, Symbol):
+        raise TypeError("symbol must be a Symbol")
+    draw_shape = False
+    shape_dict = {}
+    if shape is not None:
+        draw_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**dict(shape))
+        if out_shapes is None:
+            raise ValueError("Input shape is incomplete")
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf["nodes"]
+    node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
+                 "height": "0.8034", "style": "filled"}
+    if node_attrs:
+        node_attr.update(node_attrs)
+    dot = Digraph(name=title, format=save_format)
+    hidden_nodes = set()
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        attrs = node.get("attrs", {})
+        label = name
+        if op == "null":
+            if name.endswith("_weight") or name.endswith("_bias") or \
+                    name.endswith("_gamma") or name.endswith("_beta") or \
+                    name.endswith("_moving_mean") or name.endswith("_moving_var"):
+                if hide_weights:
+                    hidden_nodes.add(name)
+                continue
+            label = name
+            color = "#8dd3c7"
+        elif op == "Convolution":
+            label = "Convolution\n%s/%s, %s" % (
+                attrs.get("kernel", "?"), attrs.get("stride", "(1,1)"),
+                attrs.get("num_filter", "?"))
+            color = "#fb8072"
+        elif op == "FullyConnected":
+            label = "FullyConnected\n%s" % attrs.get("num_hidden", "?")
+            color = "#fb8072"
+        elif op == "BatchNorm":
+            color = "#bebada"
+        elif op == "Activation" or op == "LeakyReLU":
+            label = "%s\n%s" % (op, attrs.get("act_type", ""))
+            color = "#ffffb3"
+        elif op == "Pooling":
+            label = "Pooling\n%s, %s/%s" % (
+                attrs.get("pool_type", "?"), attrs.get("kernel", "?"),
+                attrs.get("stride", "(1,1)"))
+            color = "#80b1d3"
+        elif op in ("Concat", "Flatten", "Reshape"):
+            color = "#fdb462"
+        elif op == "Softmax" or op == "SoftmaxOutput":
+            color = "#fccde5"
+        else:
+            color = "#b3de69"
+        dot.node(name=name, label=label, fillcolor=color, **node_attr)
+    for node in nodes:
+        op = node["op"]
+        name = node["name"]
+        if op == "null":
+            continue
+        inputs = node["inputs"]
+        for item in inputs:
+            input_node = nodes[item[0]]
+            input_name = input_node["name"]
+            if input_name in hidden_nodes:
+                continue
+            attrs = {"dir": "back", "arrowtail": "open"}
+            if draw_shape:
+                key = input_name + "_output" if input_node["op"] != "null" \
+                    else input_name
+                if key in shape_dict:
+                    attrs["label"] = "x".join(
+                        str(x) for x in shape_dict[key][1:])
+            dot.edge(tail_name=name, head_name=input_name, **attrs)
+    return dot
