@@ -218,14 +218,20 @@ class DataParallelExecutorGroup(object):
             texec.forward(is_train=is_train)
 
     def get_output_shapes(self):
-        outputs = self.execs[0].outputs
-        shapes = [out.shape for out in outputs]
+        # infer from the symbol (executor outputs are not materialized until
+        # the first forward — unlike the reference's pre-planned NDArrays)
+        input_shapes = {(x.name if isinstance(x, DataDesc) else x[0]):
+                        (x.shape if isinstance(x, DataDesc) else x[1])
+                        for x in self.data_shapes}
+        if self.label_shapes:
+            input_shapes.update(
+                {(x.name if isinstance(x, DataDesc) else x[0]):
+                 (x.shape if isinstance(x, DataDesc) else x[1])
+                 for x in self.label_shapes})
+        _, out_shapes, _ = self.symbol.infer_shape(**input_shapes)
         concat_shapes = []
-        for key, the_shape, axis in zip(self.symbol.list_outputs(), shapes,
-                                        self.output_layouts):
-            the_shape = list(the_shape)
-            if axis >= 0:
-                the_shape[axis] = self.batch_size
+        for key, the_shape, axis in zip(self.symbol.list_outputs(),
+                                        out_shapes, self.output_layouts):
             concat_shapes.append((key, tuple(the_shape)))
         return concat_shapes
 

@@ -1,0 +1,32 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY §4): sharding/collective
+tests run on ``xla_force_host_platform_device_count=8`` CPU devices (the
+local-launcher trick for testing multi-node on one box); the same code
+runs unmodified on a real TPU mesh.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] += " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# the axon sitecustomize force-selects the TPU platform; tests run on the
+# virtual CPU mesh
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,64 @@
+"""Autograd tests (reference ``tests/python/unittest/test_autograd.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+
+def test_mark_variables_backward():
+    x = mx.nd.array(np.random.randn(3, 4).astype("f"))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2, atol=1e-5)
+
+
+def test_training_mode():
+    assert not autograd.is_training()
+    with autograd.record(train_mode=True):
+        assert autograd.is_training()
+        assert autograd.is_recording()
+    assert not autograd.is_recording()
+
+
+def test_chain_ops():
+    x = mx.nd.array(np.abs(np.random.randn(4).astype("f")) + 0.5)
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.log(mx.nd.sqrt(x))
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 0.5 / x.asnumpy(), atol=1e-5)
+
+
+def test_multiple_inputs():
+    a = mx.nd.array(np.random.randn(3).astype("f"))
+    b = mx.nd.array(np.random.randn(3).astype("f"))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b
+    c.backward()
+    assert np.allclose(a.grad.asnumpy(), b.asnumpy(), atol=1e-6)
+    assert np.allclose(b.grad.asnumpy(), a.asnumpy(), atol=1e-6)
+
+
+def test_out_grad():
+    x = mx.nd.ones((3,))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 4
+    y.backward(mx.nd.array([1.0, 2.0, 3.0]))
+    assert np.allclose(x.grad.asnumpy(), [4, 8, 12])
+
+
+def test_pause():
+    x = mx.nd.ones((2,))
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with autograd.pause():
+            z = y * 3  # not recorded
+        w = y + 1
+    w.backward()
+    assert np.allclose(x.grad.asnumpy(), [2, 2])

@@ -1,0 +1,96 @@
+"""Executor tests (reference ``tests/python/unittest/test_executor.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_bind_forward_backward():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b + a
+    av = np.random.randn(3, 4).astype("f")
+    bv = np.random.randn(3, 4).astype("f")
+    exe = c.bind(mx.cpu(), {"a": mx.nd.array(av), "b": mx.nd.array(bv)},
+                 args_grad={"a": mx.nd.zeros((3, 4)),
+                            "b": mx.nd.zeros((3, 4))})
+    out = exe.forward(is_train=True)[0].asnumpy()
+    assert np.allclose(out, av * bv + av, atol=1e-6)
+    og = np.random.randn(3, 4).astype("f")
+    exe.backward(mx.nd.array(og))
+    assert np.allclose(exe.grad_dict["a"].asnumpy(), og * (bv + 1), atol=1e-5)
+    assert np.allclose(exe.grad_dict["b"].asnumpy(), og * av, atol=1e-5)
+
+
+def test_grad_req_add():
+    a = mx.sym.Variable("a")
+    out = mx.symbol.square(a)
+    av = np.random.randn(2, 2).astype("f")
+    ga = mx.nd.ones((2, 2))
+    exe = out.bind(mx.cpu(), {"a": mx.nd.array(av)}, args_grad={"a": ga},
+                   grad_req="add")
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((2, 2)))
+    assert np.allclose(ga.asnumpy(), 1 + 2 * av, atol=1e-5)
+
+
+def test_grad_req_null():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    out = a * b
+    exe = out.bind(mx.cpu(), {"a": mx.nd.ones((2,)), "b": mx.nd.ones((2,))},
+                   args_grad={"a": mx.nd.zeros((2,))},
+                   grad_req={"a": "write", "b": "null"})
+    exe.forward(is_train=True)
+    exe.backward(mx.nd.ones((2,)))
+    assert np.allclose(exe.grad_dict["a"].asnumpy(), [1, 1])
+
+
+def test_simple_bind():
+    x = mx.sym.Variable("x")
+    fc = mx.symbol.FullyConnected(x, num_hidden=4, name="fc")
+    exe = fc.simple_bind(ctx=mx.cpu(), x=(2, 3))
+    assert exe.arg_dict["fc_weight"].shape == (4, 3)
+    exe.forward()
+    assert exe.outputs[0].shape == (2, 4)
+
+
+def test_forward_kwargs_update():
+    x = mx.sym.Variable("x")
+    out = mx.symbol.square(x)
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 2))
+    o1 = exe.forward(x=np.full((2, 2), 2.0, dtype="f"))[0].asnumpy()
+    assert np.allclose(o1, 4)
+    o2 = exe.forward(x=np.full((2, 2), 3.0, dtype="f"))[0].asnumpy()
+    assert np.allclose(o2, 9)
+
+
+def test_reshape():
+    x = mx.sym.Variable("x")
+    fc = mx.symbol.FullyConnected(x, num_hidden=4, name="fc")
+    exe = fc.simple_bind(ctx=mx.cpu(), x=(2, 3))
+    exe.arg_dict["fc_weight"][:] = 1.0
+    new_exe = exe.reshape(x=(5, 3))
+    assert new_exe.arg_dict["x"].shape == (5, 3)
+    # weights carried over
+    assert np.allclose(new_exe.arg_dict["fc_weight"].asnumpy(), 1.0)
+    new_exe.forward()
+    assert new_exe.outputs[0].shape == (5, 4)
+
+
+def test_output_dict():
+    x = mx.sym.Variable("x")
+    out = mx.symbol.tanh(x, name="t")
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 2))
+    exe.forward()
+    assert "t_output" in exe.output_dict
+
+
+def test_monitor_callback():
+    x = mx.sym.Variable("x")
+    h = mx.symbol.tanh(x, name="t")
+    out = mx.symbol.square(h, name="s")
+    exe = out.simple_bind(ctx=mx.cpu(), x=(2, 2))
+    seen = []
+    exe.install_monitor(lambda name, arr: seen.append(name))
+    exe.forward()
+    assert "t_output" in seen and "s_output" in seen

@@ -1,0 +1,79 @@
+"""Shape inference tests (reference
+``tests/python/unittest/test_infer_shape.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_mlp_infer():
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, name="fc1", num_hidden=30)
+    act = mx.symbol.Activation(fc1, act_type="relu")
+    fc2 = mx.symbol.FullyConnected(act, name="fc2", num_hidden=10)
+    out = mx.symbol.SoftmaxOutput(fc2, name="sm")
+    arg_shapes, out_shapes, _ = out.infer_shape(data=(100, 50))
+    shapes = dict(zip(out.list_arguments(), arg_shapes))
+    assert shapes["fc1_weight"] == (30, 50)
+    assert shapes["fc1_bias"] == (30,)
+    assert shapes["fc2_weight"] == (10, 30)
+    assert shapes["sm_label"] == (100,)
+    assert out_shapes == [(100, 10)]
+
+
+def test_conv_infer():
+    data = mx.sym.Variable("data")
+    conv = mx.symbol.Convolution(data, num_filter=16, kernel=(3, 3),
+                                 stride=(2, 2), pad=(1, 1), name="conv")
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(4, 3, 32, 32))
+    shapes = dict(zip(conv.list_arguments(), arg_shapes))
+    assert shapes["conv_weight"] == (16, 3, 3, 3)
+    assert out_shapes == [(4, 16, 16, 16)]
+
+
+def test_backward_infer_from_weight():
+    """Weight shape given, data dim inferred (reference
+    test_infer_shape.py backward inference)."""
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, name="fc1", num_hidden=30)
+    arg_shapes, out_shapes, _ = fc1.infer_shape(data=(10, 50))
+    assert out_shapes[0] == (10, 30)
+
+
+def test_incomplete_infer_partial():
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, name="fc1", num_hidden=30)
+    arg_shapes, out_shapes, _ = fc1.infer_shape_partial()
+    # with no shapes known, args stay None rather than raising
+    assert out_shapes[0] is None or out_shapes[0] == ()
+
+
+def test_mismatch_raises():
+    a = mx.sym.Variable("a")
+    b = mx.symbol.elemwise_add(a, a)
+    with pytest.raises(mx.MXNetError):
+        # inconsistent: elemwise over mismatched shapes
+        c = mx.symbol.elemwise_add(mx.sym.Variable("x"), mx.sym.Variable("y"))
+        c.infer_shape(x=(2, 3), y=(3, 2))
+
+
+def test_batchnorm_aux_shapes():
+    data = mx.sym.Variable("data")
+    bn = mx.symbol.BatchNorm(data, name="bn")
+    arg_shapes, out_shapes, aux_shapes = bn.infer_shape(data=(4, 8, 5, 5))
+    assert aux_shapes == [(8,), (8,)]
+    assert out_shapes[0] == (4, 8, 5, 5)
+
+
+def test_reshape_infer():
+    data = mx.sym.Variable("data")
+    r = mx.symbol.Reshape(data, shape=(-1, 6))
+    _, out_shapes, _ = r.infer_shape(data=(4, 3, 2))
+    assert out_shapes == [(4, 6)]
+
+
+def test_variable_shape_attr_used():
+    v = mx.sym.Variable("v", shape=(5, 5))
+    out = mx.symbol.tanh(v)
+    _, out_shapes, _ = out.infer_shape()
+    assert out_shapes == [(5, 5)]

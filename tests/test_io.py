@@ -1,0 +1,144 @@
+"""IO tests (reference ``tests/python/unittest/test_io.py``)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, recordio
+
+
+def test_NDArrayIter():
+    data = np.ones([1000, 2, 2])
+    label = np.ones([1000, 1])
+    for i in range(1000):
+        data[i] = i / 100
+        label[i] = i / 100
+    dataiter = io.NDArrayIter(data, label, 128, True,
+                              last_batch_handle="pad")
+    batchidx = 0
+    for batch in dataiter:
+        batchidx += 1
+    assert batchidx == 8
+    dataiter = io.NDArrayIter(data, label, 128, False,
+                              last_batch_handle="pad")
+    batchidx = 0
+    labelcount = [0] * 10
+    for batch in dataiter:
+        label = batch.label[0].asnumpy().flatten()
+        assert (batch.data[0].asnumpy()[:, 0, 0] == label).all()
+        for i in range(label.shape[0]):
+            labelcount[int(label[i])] += 1
+    for i in range(10):
+        if i == 0:
+            # pad duplicated the first entries
+            assert labelcount[i] == 124
+        else:
+            assert labelcount[i] == 100
+
+
+def test_NDArrayIter_discard():
+    data = np.arange(100).reshape(100, 1)
+    it = io.NDArrayIter(data, np.zeros(100), 32,
+                        last_batch_handle="discard")
+    n = sum(1 for _ in it)
+    assert n == 3
+
+
+def test_resize_iter():
+    data = np.random.rand(30, 2)
+    it = io.NDArrayIter(data, np.zeros(30), batch_size=10)
+    r = io.ResizeIter(it, 7)
+    assert sum(1 for _ in r) == 7
+    r.reset()
+    assert sum(1 for _ in r) == 7
+
+
+def test_prefetching_iter():
+    data = np.random.rand(40, 3)
+    base = io.NDArrayIter(data, np.zeros(40), batch_size=10)
+    pf = io.PrefetchingIter(base)
+    seen = [b.data[0].asnumpy() for b in pf]
+    assert len(seen) == 4
+    pf.reset()
+    assert sum(1 for _ in pf) == 4
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(24, 6).astype("f")
+    label = np.arange(24).astype("f")
+    dpath = str(tmp_path / "d.csv")
+    lpath = str(tmp_path / "l.csv")
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = io.CSVIter(data_csv=dpath, data_shape=(6,), label_csv=lpath,
+                    batch_size=8)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (8, 6)
+    got = np.concatenate([b.data[0].asnumpy() for b in batches])
+    assert np.allclose(got, data, atol=1e-5)
+
+
+def test_mnist_iter(tmp_path):
+    """Synthesize an MNIST-format file pair and read it back."""
+    import gzip
+    import struct
+    n = 50
+    images = np.random.randint(0, 255, (n, 28, 28), dtype=np.uint8)
+    labels = np.random.randint(0, 10, (n,), dtype=np.uint8)
+    img_path = str(tmp_path / "img-idx3-ubyte")
+    lbl_path = str(tmp_path / "lbl-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    it = io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                      shuffle=False, silent=True)
+    batches = list(it)
+    assert len(batches) == 5
+    assert batches[0].data[0].shape == (10, 1, 28, 28)
+    got = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert np.allclose(got, labels)
+    # flat mode
+    it = io.MNISTIter(image=img_path, label=lbl_path, batch_size=10,
+                      flat=True, shuffle=False, silent=True)
+    assert next(iter(it)).data[0].shape == (10, 784)
+
+
+def test_image_record_iter(tmp_path):
+    rec = str(tmp_path / "img.rec")
+    idx = str(tmp_path / "img.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(32):
+        img = rng.randint(0, 255, (36, 36, 3), dtype=np.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 4), i, 0), img))
+    w.close()
+    it = io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                            data_shape=(3, 32, 32), batch_size=8,
+                            shuffle=True, rand_crop=True, rand_mirror=True,
+                            preprocess_threads=2)
+    count = 0
+    labels = []
+    for b in it:
+        count += 1
+        assert b.data[0].shape == (8, 3, 32, 32)
+        labels.extend(b.label[0].asnumpy().tolist())
+    assert count == 4
+    assert sorted(set(labels)) == [0.0, 1.0, 2.0, 3.0]
+    # sharding
+    it_half = io.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                                 data_shape=(3, 32, 32), batch_size=8,
+                                 num_parts=2, part_index=0,
+                                 preprocess_threads=2)
+    assert sum(1 for _ in it_half) == 2
+
+
+def test_DataBatch_str():
+    batch = io.DataBatch(data=[mx.nd.ones((2, 3))],
+                         label=[mx.nd.ones((2,))])
+    assert "(2, 3)" in str(batch)

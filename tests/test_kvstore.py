@@ -1,0 +1,107 @@
+"""KVStore tests (reference ``tests/python/unittest/test_kvstore.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import kvstore
+
+SHAPE = (4, 4)
+KEYS = [5, 7, 11]
+
+
+def _init_kv(kind="local"):
+    kv = kvstore.create(kind)
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.init(KEYS, [mx.nd.zeros(SHAPE)] * len(KEYS))
+    return kv
+
+
+def _check_diff_to_scalar(arr, x):
+    assert np.sum(np.abs(arr.asnumpy() - x)) == 0, arr.asnumpy()
+
+
+def test_single_kv_pair():
+    kv = _init_kv()
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 1)
+
+
+def test_list_kv_pair():
+    kv = _init_kv()
+    kv.push(KEYS, [mx.nd.ones(SHAPE) * 4] * len(KEYS))
+    outs = [mx.nd.zeros(SHAPE) for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for out in outs:
+        _check_diff_to_scalar(out, 4)
+
+
+def test_aggregator():
+    """Values pushed from N 'devices' are summed (reference
+    ``test_kvstore.py:40``)."""
+    kv = _init_kv()
+    num_devs = 4
+    vals = [mx.nd.ones(SHAPE) for _ in range(num_devs)]
+    kv.push(3, vals)
+    outs = [mx.nd.zeros(SHAPE) for _ in range(num_devs)]
+    kv.pull(3, out=outs)
+    for out in outs:
+        _check_diff_to_scalar(out, num_devs)
+    # list keys
+    kv.push(KEYS, [[mx.nd.ones(SHAPE) * 2.0] * num_devs] * len(KEYS))
+    outs = [[mx.nd.zeros(SHAPE) for _ in range(num_devs)] for _ in KEYS]
+    kv.pull(KEYS, out=outs)
+    for o in outs:
+        for out in o:
+            _check_diff_to_scalar(out, num_devs * 2.0)
+
+
+def test_updater():
+    kv = _init_kv()
+
+    def updater(key, recv, local):
+        local += recv
+
+    kv._set_updater(updater)
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 4)
+    # push twice accumulates through the updater
+    kv.push(3, [mx.nd.ones(SHAPE)] * 4)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 8)
+
+
+def test_set_optimizer_test_optimizer():
+    kv = _init_kv()
+    kv.set_optimizer(mx.optimizer.Test())
+    kv.push(3, mx.nd.ones(SHAPE))
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 1)
+
+
+def test_dist_sync_tpu_single_process():
+    """dist_sync_tpu degrades to local semantics in one process (the
+    reference tests dist via local process launch; here 1-proc psum is
+    the identity)."""
+    kv = kvstore.create("dist_sync_tpu")
+    assert kv.rank == 0
+    assert kv.num_workers == 1
+    kv.init(3, mx.nd.zeros(SHAPE))
+    kv.push(3, mx.nd.ones(SHAPE) * 3)
+    out = mx.nd.zeros(SHAPE)
+    kv.pull(3, out=out)
+    _check_diff_to_scalar(out, 3)
+
+
+def test_dist_async_raises():
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        kvstore.create("dist_async")
+
+
+def test_get_type():
+    kv = kvstore.create("local")
+    assert kv.type == "local"

@@ -1,0 +1,168 @@
+"""Module tests (reference ``tests/python/unittest/test_module.py``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+from mxnet_tpu.module import Module, BucketingModule, SequentialModule
+
+
+def _softmax_mlp(nh=16, nout=2, prefix=""):
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, num_hidden=nh, name=prefix + "fc1")
+    act = mx.symbol.Activation(fc1, act_type="relu")
+    fc2 = mx.symbol.FullyConnected(act, num_hidden=nout, name=prefix + "fc2")
+    return mx.symbol.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_data(n=200, d=10, k=2, batch=20, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, d).astype("f")
+    w = rng.randn(d, k).astype("f")
+    y = np.argmax(x @ w, axis=1).astype("f")
+    return io.NDArrayIter(x, y, batch_size=batch, shuffle=False)
+
+
+def test_module_train_acc():
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    train.reset()
+    score = mod.score(train, "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_forward_shapes():
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (8, 10))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    batch = io.DataBatch(data=[mx.nd.ones((8, 10))],
+                         label=[mx.nd.zeros((8,))], pad=0)
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (8, 2)
+
+
+def test_module_input_grads():
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))],
+             for_training=True, inputs_need_grad=True)
+    mod.init_params()
+    batch = io.DataBatch(data=[mx.nd.ones((4, 10))],
+                         label=[mx.nd.zeros((4,))], pad=0)
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    grads = mod.get_input_grads()
+    assert grads[0].shape == (4, 10)
+    assert np.abs(grads[0].asnumpy()).sum() > 0
+
+
+def test_module_save_load(tmp_path):
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=2, optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 2)
+    mod2 = Module.load(prefix, 2)
+    mod2.bind(data_shapes=[("data", (20, 10))],
+              label_shapes=[("softmax_label", (20,))], for_training=False)
+    train.reset()
+    s1 = mod.score(train, "acc")[0][1]
+    train.reset()
+    s2 = mod2.score(train, "acc")[0][1]
+    assert abs(s1 - s2) < 1e-6
+
+
+def test_module_predict():
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    train.reset()
+    out = mod.predict(train)
+    assert out.shape == (200, 2)
+
+
+def test_module_multi_device():
+    train = _toy_data(batch=40)
+    mod = Module(_softmax_mlp(), context=[mx.cpu(0), mx.cpu(1)])
+    mod.fit(train, num_epoch=3, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    train.reset()
+    assert mod.score(train, "acc")[0][1] > 0.9
+
+
+def test_module_mesh_fused():
+    from mxnet_tpu import parallel
+    mesh = parallel.make_mesh({"data": 4})
+    train = _toy_data(batch=40)
+    mod = Module(_softmax_mlp(), context=mesh)
+    mod.fit(train, num_epoch=3, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    train.reset()
+    assert mod.score(train, "acc")[0][1] > 0.9
+
+
+def test_module_optimizer_state_roundtrip(tmp_path):
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9})
+    fname = str(tmp_path / "opt.states")
+    mod.save_optimizer_states(fname)
+    mod.load_optimizer_states(fname)
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        fc = mx.symbol.FullyConnected(data, num_hidden=4, name="fc")
+        sm = mx.symbol.SoftmaxOutput(fc, name="softmax")
+        return sm, ("data",), ("softmax_label",)
+
+    mod = BucketingModule(sym_gen, default_bucket_key=10, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer_params={"learning_rate": 0.1})
+    for key in [10, 6, 10, 8]:
+        batch = io.DataBatch(
+            data=[mx.nd.ones((4, key))], label=[mx.nd.zeros((4,))], pad=0,
+            bucket_key=key,
+            provide_data=[io.DataDesc("data", (4, key))],
+            provide_label=[io.DataDesc("softmax_label", (4,))])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    assert set(mod._buckets) == {10, 6, 8}
+    # shared params: updating via one bucket is visible in get_params
+    arg_params, _ = mod.get_params()
+    assert "fc_weight" in arg_params
+
+
+def test_sequential_module():
+    net1 = mx.symbol.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                    name="fc1")
+    net1 = mx.symbol.Activation(net1, act_type="relu", name="a1")
+    net2 = mx.symbol.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                    name="fc2")
+    net2 = mx.symbol.SoftmaxOutput(net2, name="softmax")
+    mod1 = Module(net1, label_names=None, context=mx.cpu())
+    mod2 = Module(net2, context=mx.cpu())
+    seq = SequentialModule()
+    seq.add(mod1).add(mod2, take_labels=True, auto_wiring=True)
+    train = _toy_data()
+    seq.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    seq.init_params(initializer=mx.init.Xavier())
+    seq.init_optimizer(optimizer_params={"learning_rate": 0.5})
+    metric = mx.metric.create("acc")
+    for epoch in range(5):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            seq.forward_backward(batch)
+            seq.update()
+            seq.update_metric(metric, batch.label)
+    assert metric.get()[1] > 0.8

@@ -1,0 +1,133 @@
+"""Optimizer tests (reference ``tests/python/unittest/test_optimizer.py``):
+each update rule validated against a straightforward numpy implementation."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _run_updates(optimizer, w0, grads):
+    w = mx.nd.array(w0.copy())
+    state = optimizer.create_state(0, w)
+    for g in grads:
+        optimizer.update(0, w, mx.nd.array(g), state)
+    return w.asnumpy()
+
+
+def test_sgd_matches_numpy():
+    w0 = np.random.randn(4, 3).astype("f")
+    grads = [np.random.randn(4, 3).astype("f") for _ in range(5)]
+    got = _run_updates(opt.SGD(learning_rate=0.1, rescale_grad=1.0), w0, grads)
+    w = w0.copy()
+    for g in grads:
+        w -= 0.1 * g
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_sgd_momentum_wd():
+    w0 = np.random.randn(4, 3).astype("f")
+    grads = [np.random.randn(4, 3).astype("f") for _ in range(5)]
+    got = _run_updates(opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01,
+                               rescale_grad=1.0, param_idx2name={0: "w_weight"}),
+                       w0, grads)
+    w = w0.copy()
+    mom = np.zeros_like(w)
+    for g in grads:
+        gg = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gg
+        w = w + mom
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_adam_matches_numpy():
+    w0 = np.random.randn(4, 3).astype("f")
+    grads = [np.random.randn(4, 3).astype("f") for _ in range(5)]
+    got = _run_updates(opt.Adam(learning_rate=0.01, rescale_grad=1.0),
+                       w0, grads)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t, g in enumerate(grads, 1):
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        coef = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        w = w - coef * m / (np.sqrt(v) + 1e-8)
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_rmsprop_matches_numpy():
+    w0 = np.random.randn(4, 3).astype("f")
+    grads = [np.random.randn(4, 3).astype("f") for _ in range(3)]
+    got = _run_updates(opt.RMSProp(learning_rate=0.01, gamma1=0.9,
+                                   rescale_grad=1.0), w0, grads)
+    w = w0.copy()
+    n = np.zeros_like(w)
+    for g in grads:
+        n = 0.1 * g * g + 0.9 * n
+        w = w - 0.01 * g / np.sqrt(n + 1e-8)
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_adagrad_matches_numpy():
+    w0 = np.random.randn(4, 3).astype("f")
+    grads = [np.random.randn(4, 3).astype("f") for _ in range(3)]
+    got = _run_updates(opt.AdaGrad(learning_rate=0.1, rescale_grad=1.0,
+                                   param_idx2name={0: "w_weight"}, wd=0.0),
+                       w0, grads)
+    w = w0.copy()
+    h = np.zeros_like(w)
+    for g in grads:
+        h += g * g
+        w = w - 0.1 * g / np.sqrt(h + 1e-7)
+    assert np.allclose(got, w, atol=1e-5)
+
+
+def test_clip_gradient():
+    w0 = np.zeros((2, 2), dtype="f")
+    grads = [np.full((2, 2), 10.0, dtype="f")]
+    got = _run_updates(opt.SGD(learning_rate=1.0, rescale_grad=1.0,
+                               clip_gradient=0.5), w0, grads)
+    assert np.allclose(got, -0.5)
+
+
+def test_lr_scheduler_integration():
+    sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.5)
+    o = opt.SGD(learning_rate=1.0, lr_scheduler=sched, rescale_grad=1.0)
+    w = mx.nd.zeros((1,))
+    g = mx.nd.ones((1,))
+    deltas = []
+    prev = 0.0
+    for i in range(6):
+        o.update(0, w, g, None)
+        cur = w.asnumpy()[0]
+        deltas.append(prev - cur)
+        prev = cur
+    # lr decays by 0.5 every 2 updates
+    assert deltas[0] > deltas[-1]
+
+
+def test_updater_states_roundtrip():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = mx.nd.ones((2, 2))
+    u(0, mx.nd.ones((2, 2)), w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    u2.set_states(states)
+    assert 0 in u2.states
+
+
+def test_create_by_name():
+    for name in ["sgd", "adam", "rmsprop", "adagrad", "adadelta", "ftrl",
+                 "nag", "sgld", "dcasgd", "test"]:
+        o = opt.create(name)
+        assert isinstance(o, opt.Optimizer)
+
+
+def test_wd_mult_by_name():
+    o = opt.SGD(learning_rate=0.1, wd=0.1,
+                param_idx2name={0: "fc_weight", 1: "fc_bias"})
+    # biases get wd_mult 0 by default
+    assert o.wd_mult.get("fc_bias") == 0.0
+    assert o._get_wd(0) == 0.1
+    assert o._get_wd(1) == 0.0

@@ -1,0 +1,119 @@
+"""RNN cell tests (reference ``tests/python/unittest/test_rnn.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def test_rnn_cell():
+    cell = mx.rnn.RNNCell(100, prefix="rnn_")
+    outputs, _ = cell.unroll(3, inputs=[mx.sym.Variable("rnn_t0_data"),
+                                        mx.sym.Variable("rnn_t1_data"),
+                                        mx.sym.Variable("rnn_t2_data")])
+    outputs = mx.sym.Group(outputs)
+    assert sorted(cell.params._params.keys()) == \
+        ["rnn_h2h_bias", "rnn_h2h_weight", "rnn_i2h_bias", "rnn_i2h_weight"]
+    args, outs, auxs = outputs.infer_shape(rnn_t0_data=(10, 50),
+                                           rnn_t1_data=(10, 50),
+                                           rnn_t2_data=(10, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_lstm_cell():
+    cell = mx.rnn.LSTMCell(100, prefix="lstm_")
+    outputs, _ = cell.unroll(3, inputs=mx.sym.Variable("seq"), layout="NTC",
+                             merge_outputs=False)
+    outputs = mx.sym.Group(outputs)
+    args, outs, auxs = outputs.infer_shape(seq=(10, 3, 50))
+    assert outs == [(10, 100), (10, 100), (10, 100)]
+
+
+def test_gru_cell():
+    cell = mx.rnn.GRUCell(100, prefix="gru_")
+    outputs, _ = cell.unroll(3, inputs=mx.sym.Variable("seq"), layout="NTC",
+                             merge_outputs=True)
+    args, outs, auxs = outputs.infer_shape(seq=(10, 3, 50))
+    assert outs == [(10, 3, 100)]
+
+
+def test_stacked_and_bidirectional():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(32, prefix="l0_"))
+    stack.add(mx.rnn.LSTMCell(32, prefix="l1_"))
+    outputs, states = stack.unroll(4, inputs=mx.sym.Variable("seq"),
+                                   merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(seq=(2, 4, 16))
+    assert outs == [(2, 4, 32)]
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(16, prefix="bl_"),
+                                  mx.rnn.LSTMCell(16, prefix="br_"))
+    outputs, states = bi.unroll(3, inputs=mx.sym.Variable("seq"),
+                                merge_outputs=True)
+    _, outs, _ = outputs.infer_shape(seq=(2, 3, 8))
+    assert outs == [(2, 3, 32)]
+
+
+def test_fused_rnn_runs():
+    fused = mx.rnn.FusedRNNCell(24, num_layers=2, mode="lstm",
+                                prefix="f_")
+    outputs, _ = fused.unroll(5, inputs=mx.sym.Variable("seq"),
+                              merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), seq=(3, 5, 12))
+    exe.forward(is_train=True)
+    assert exe.outputs[0].shape == (3, 5, 24)
+    exe.backward()
+
+
+def test_unroll_trains():
+    """A one-layer LSTM learns a trivial memory task end to end."""
+    T, N, C = 4, 32, 4
+    cell = mx.rnn.LSTMCell(16, prefix="lstm_")
+    outputs, _ = cell.unroll(T, inputs=mx.sym.Variable("data"),
+                             merge_outputs=False)
+    fc = mx.symbol.FullyConnected(outputs[-1], num_hidden=2, name="out")
+    net = mx.symbol.SoftmaxOutput(fc, name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.randn(200, T, C).astype("f")
+    y = (x[:, 0, 0] > 0).astype("f")  # remember the first timestep
+    from mxnet_tpu import io
+    train = io.NDArrayIter(x, y, batch_size=N, shuffle=False)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=10, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.init.Xavier())
+    train.reset()
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_bucket_sentence_iter():
+    sentences = [[1, 2, 3], [2, 3], [1, 2, 3, 4, 5], [3, 4], [2, 1, 4]] * 4
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[3, 5], invalid_label=0)
+    batches = list(it)
+    assert len(batches) >= 1
+    for b in batches:
+        assert b.bucket_key in (3, 5)
+        assert b.data[0].shape[0] == 4
+
+
+def test_zoneout_residual_dropout():
+    base = mx.rnn.RNNCell(8, prefix="z_")
+    zc = mx.rnn.ZoneoutCell(base, zoneout_outputs=0.2)
+    outputs, _ = zc.unroll(3, inputs=mx.sym.Variable("seq"),
+                           merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), seq=(2, 3, 4))
+    exe.forward(is_train=True)
+
+    res = mx.rnn.ResidualCell(mx.rnn.RNNCell(4, prefix="r_"))
+    outputs, _ = res.unroll(3, inputs=mx.sym.Variable("seq"),
+                            merge_outputs=True)
+    exe = outputs.simple_bind(ctx=mx.cpu(), seq=(2, 3, 4))
+    exe.forward()
+    assert exe.outputs[0].shape == (2, 3, 4)
+
+
+def test_encode_sentences():
+    sents = [["a", "b"], ["b", "c"]]
+    coded, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(vocab) >= 3
+    assert coded[0][1] == coded[1][0]  # same token "b"

@@ -1,0 +1,128 @@
+"""Symbol tests (reference ``tests/python/unittest/test_symbol.py``)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym_mod
+
+
+def _mlp():
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data=data, name="fc1", num_hidden=10)
+    act = mx.symbol.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.symbol.FullyConnected(act, name="fc2", num_hidden=10)
+    return mx.symbol.SoftmaxOutput(fc2, name="sm")
+
+
+def test_symbol_compose():
+    net = _mlp()
+    assert net.list_arguments() == [
+        "data", "fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias",
+        "sm_label"]
+    assert net.list_outputs() == ["sm_output"]
+
+
+def test_symbol_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert "fc1_output" in outs
+    assert "relu1_output" in outs
+    fc1 = internals["fc1_output"]
+    assert fc1.list_arguments() == ["data", "fc1_weight", "fc1_bias"]
+
+
+def test_symbol_children():
+    data = mx.sym.Variable("data")
+    fc = mx.symbol.FullyConnected(data, num_hidden=4, name="fc")
+    ch = fc.get_children()
+    assert ch is not None
+    names = [c.name for c in ch]
+    assert names == ["data", "fc_weight", "fc_bias"]
+
+
+def test_compose_with_kwargs():
+    lhs = mx.sym.Variable("lhs")
+    rhs = mx.sym.Variable("rhs")
+    out = mx.symbol.elemwise_add(lhs=lhs, rhs=rhs, name="add")
+    assert out.list_arguments() == ["lhs", "rhs"]
+
+
+def test_symbol_arith():
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    for s in [a + b, a - b, a * b, a / b, a + 1, 2 * a, a ** 2, -a]:
+        assert isinstance(s, sym_mod.Symbol)
+    ex = (a * 2 + b).bind(mx.cpu(), {"a": mx.nd.ones((2,)),
+                                     "b": mx.nd.ones((2,)) * 3})
+    assert np.allclose(ex.forward()[0].asnumpy(), [5, 5])
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = sym_mod.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # numerically identical executors
+    shapes = dict(data=(2, 8))
+    e1 = net.simple_bind(ctx=mx.cpu(), **shapes)
+    e2 = net2.simple_bind(ctx=mx.cpu(), **shapes)
+    for k in e1.arg_dict:
+        e2.arg_dict[k][:] = e1.arg_dict[k].asnumpy()
+    o1 = e1.forward()[0].asnumpy()
+    o2 = e2.forward()[0].asnumpy()
+    assert np.allclose(o1, o2)
+
+
+def test_group():
+    a = mx.sym.Variable("a")
+    b = mx.symbol.tanh(a, name="t")
+    g = mx.sym.Group([b, mx.symbol.sqrt(a, name="s")])
+    assert g.list_outputs() == ["t_output", "s_output"]
+    assert len(g) == 2
+
+
+def test_symbol_slicing():
+    a = mx.sym.Variable("a")
+    out = mx.symbol.SliceChannel(a, num_outputs=3, name="sl")
+    assert len(out) == 3
+    one = out[1]
+    assert one.list_outputs() == ["sl_output1"]
+
+
+def test_variable_attrs():
+    v = mx.sym.Variable("w", shape=(3, 4), lr_mult=2.0, wd_mult=0.5)
+    assert v.attr("__shape__") == str((3, 4))
+    assert v.attr("__lr_mult__") == "2.0"
+    ad = v.attr_dict()
+    assert ad["w"]["__wd_mult__"] == "0.5"
+
+
+def test_attr_scope():
+    with mx.AttrScope(ctx_group="dev1"):
+        v = mx.sym.Variable("x")
+    assert v.attr("ctx_group") == "dev1"
+
+
+def test_infer_type():
+    a = mx.sym.Variable("a")
+    b = mx.symbol.exp(a)
+    arg_types, out_types, _ = b.infer_type(a=np.float64)
+    assert arg_types[0] == np.float64
+    assert out_types[0] == np.float64
+
+
+def test_save_load(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "net.json")
+    net.save(fname)
+    net2 = sym_mod.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_auto_naming():
+    data = mx.sym.Variable("data")
+    fc = mx.symbol.FullyConnected(data, num_hidden=3)
+    assert fc.name.startswith("fullyconnected")
+    fc2 = mx.symbol.FullyConnected(data, num_hidden=3)
+    assert fc.name != fc2.name

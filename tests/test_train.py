@@ -1,0 +1,101 @@
+"""Integration training test (reference ``tests/python/train/test_mlp.py``:
+train an MLP and assert accuracy > 0.95)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def _synthetic_mnist(n=2000, seed=0):
+    """Deterministic separable 10-class problem standing in for MNIST
+    (zero-egress test env; the reference's test downloads the real data)."""
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(10, 784).astype("f") * 2.0
+    y = rng.randint(0, 10, n)
+    x = centers[y] + rng.randn(n, 784).astype("f") * 0.8
+    return x.astype("f"), y.astype("f")
+
+
+def test_mlp_accuracy():
+    x, y = _synthetic_mnist()
+    train = io.NDArrayIter(x[:1600], y[:1600], batch_size=100, shuffle=True)
+    val = io.NDArrayIter(x[1600:], y[1600:], batch_size=100)
+
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, name="fc1", num_hidden=128)
+    act1 = mx.symbol.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(act1, name="fc2", num_hidden=64)
+    act2 = mx.symbol.Activation(fc2, name="relu2", act_type="relu")
+    fc3 = mx.symbol.FullyConnected(act2, name="fc3", num_hidden=10)
+    softmax = mx.symbol.SoftmaxOutput(fc3, name="softmax")
+
+    mod = mx.mod.Module(softmax, context=mx.cpu())
+    mod.fit(train, eval_data=val, num_epoch=5,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / 100},
+            initializer=mx.init.Xavier())
+    val.reset()
+    acc = mod.score(val, "acc")[0][1]
+    assert acc > 0.95, acc
+
+
+def test_lenet_conv_trains():
+    """Small conv net (reference ``test_conv.py``) on a downscaled input."""
+    rng = np.random.RandomState(0)
+    n = 400
+    centers = rng.randn(4, 1, 12, 12).astype("f") * 1.5
+    y = rng.randint(0, 4, n)
+    x = centers[y] + rng.randn(n, 1, 12, 12).astype("f") * 0.5
+    train = io.NDArrayIter(x, y.astype("f"), batch_size=50, shuffle=True)
+
+    data = mx.sym.Variable("data")
+    conv1 = mx.symbol.Convolution(data, kernel=(3, 3), num_filter=8,
+                                  name="conv1")
+    tanh1 = mx.symbol.Activation(conv1, act_type="tanh")
+    pool1 = mx.symbol.Pooling(tanh1, pool_type="max", kernel=(2, 2),
+                              stride=(2, 2))
+    flat = mx.symbol.Flatten(pool1)
+    fc = mx.symbol.FullyConnected(flat, num_hidden=4, name="fc")
+    net = mx.symbol.SoftmaxOutput(fc, name="softmax")
+
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=4,
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "rescale_grad": 1.0 / 50},
+            initializer=mx.init.Xavier())
+    train.reset()
+    acc = mod.score(train, "acc")[0][1]
+    assert acc > 0.9, acc
+
+
+def test_feedforward_api():
+    x, y = _synthetic_mnist(n=500)
+    data = mx.sym.Variable("data")
+    fc = mx.symbol.FullyConnected(data, num_hidden=10, name="fc")
+    net = mx.symbol.SoftmaxOutput(fc, name="softmax")
+    model = mx.model.FeedForward(net, ctx=mx.cpu(), num_epoch=3,
+                                 learning_rate=0.1,
+                                 initializer=mx.init.Xavier())
+    model.fit(x, y)
+    preds = model.predict(x)
+    assert preds.shape == (500, 10)
+    acc = (preds.argmax(axis=1) == y).mean()
+    assert acc > 0.8
+
+
+def test_checkpoint_callback(tmp_path):
+    x, y = _synthetic_mnist(n=200)
+    train = io.NDArrayIter(x, y, batch_size=50)
+    data = mx.sym.Variable("data")
+    fc = mx.symbol.FullyConnected(data, num_hidden=10, name="fc")
+    net = mx.symbol.SoftmaxOutput(fc, name="softmax")
+    prefix = str(tmp_path / "chk")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(train, num_epoch=2,
+            epoch_end_callback=mx.callback.do_checkpoint(prefix),
+            optimizer_params={"learning_rate": 0.1})
+    import os
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0002.params")
+    sym, arg, aux = mx.model.load_checkpoint(prefix, 2)
+    assert "fc_weight" in arg
