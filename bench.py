@@ -1,0 +1,66 @@
+"""Driver benchmark: ResNet-50 ImageNet training throughput (img/s) on one
+chip, synthetic data (the reference's ``--benchmark 1`` mode), bf16 compute
+with f32 master weights, whole train step (fwd+bwd+SGD-momentum update) as
+one jitted XLA computation.
+
+Baseline: the reference's best published single-device number — ResNet-50
+batch-32 training on P100, 181.53 img/s (``docs/how_to/perf.md:151-183``,
+copied in BASELINE.md).  Prints ONE JSON line.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 181.53  # reference single-P100 ResNet-50 train, batch 32
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import models
+    from mxnet_tpu.parallel import Trainer
+
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    batch = 256 if on_tpu else 16
+    image = 224 if on_tpu else 64
+    steps = 20 if on_tpu else 3
+
+    sym = models.get_symbol("resnet-50", num_classes=1000)
+    trainer = Trainer(sym, mx.optimizer.SGD(learning_rate=0.1, momentum=0.9),
+                      compute_dtype="bfloat16")
+    trainer.bind(data_shapes={"data": (batch, 3, image, image)},
+                 label_shapes={"softmax_label": (batch,)})
+    trainer.init_params(mx.init.Xavier(factor_type="in", magnitude=2.0))
+
+    rng = np.random.RandomState(0)
+    x = rng.normal(0, 1, (batch, 3, image, image)).astype(np.float32)
+    y = rng.randint(0, 1000, (batch,)).astype(np.float32)
+    # stage once in HBM (synthetic-data mode measures compute, not PCIe)
+    batch_dict = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(y)}
+
+    # warmup (compile)
+    for _ in range(2):
+        outs = trainer.step(batch_dict)
+        jax.block_until_ready(outs[0].data)
+
+    # sync every step: honest wall-clock including dispatch latency
+    times = []
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        outs = trainer.step(batch_dict)
+        jax.block_until_ready(outs[0].data)
+        times.append(time.perf_counter() - t0)
+
+    img_s = batch / float(np.median(times))
+    print(json.dumps({
+        "metric": "resnet50_train_img_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

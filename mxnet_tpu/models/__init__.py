@@ -1,0 +1,54 @@
+"""Model zoo: Symbol builders for the reference's example networks.
+
+Mirrors the capability of ``example/image-classification/symbols/`` in the
+reference (mlp, lenet, alexnet, vgg, resnet, inception-bn, inception-v3)
+plus the bucketing LSTM language model (``example/rnn/lstm_bucketing.py``).
+Architectures are standard published networks, written fresh in
+mxnet_tpu Symbol idiom; the graphs compile to single XLA computations.
+
+Use :func:`get_symbol`::
+
+    sym = mx.models.get_symbol("resnet-50", num_classes=1000)
+"""
+from . import mlp
+from . import lenet
+from . import alexnet
+from . import vgg
+from . import resnet
+from . import inception_bn
+from . import inception_v3
+from . import lstm_lm
+
+__all__ = ["get_symbol", "mlp", "lenet", "alexnet", "vgg", "resnet",
+           "inception_bn", "inception_v3", "lstm_lm"]
+
+_BUILDERS = {
+    "mlp": mlp.get_symbol,
+    "lenet": lenet.get_symbol,
+    "alexnet": alexnet.get_symbol,
+    "inception-bn": inception_bn.get_symbol,
+    "inception-v3": inception_v3.get_symbol,
+}
+
+
+def get_symbol(network, num_classes=1000, **kwargs):
+    """Build a named network Symbol.
+
+    ``network`` may be a plain name (``"alexnet"``) or a name-depth form
+    (``"resnet-50"``, ``"vgg-16"``) matching the reference's
+    ``--network`` CLI strings.
+    """
+    if network in _BUILDERS:
+        return _BUILDERS[network](num_classes=num_classes, **kwargs)
+    if network.startswith("resnet"):
+        depth = int(network.split("-")[1]) if "-" in network else \
+            int(kwargs.pop("num_layers", 50))
+        return resnet.get_symbol(num_classes=num_classes, num_layers=depth,
+                                 **kwargs)
+    if network.startswith("vgg"):
+        depth = int(network.split("-")[1]) if "-" in network else \
+            int(kwargs.pop("num_layers", 16))
+        return vgg.get_symbol(num_classes=num_classes, num_layers=depth,
+                              **kwargs)
+    raise ValueError("unknown network %r (have %s, resnet-N, vgg-N)"
+                     % (network, sorted(_BUILDERS)))

@@ -1,0 +1,40 @@
+"""AlexNet (Krizhevsky et al. 2012), single-tower variant as in the
+reference ``example/image-classification/symbols/alexnet.py``."""
+from .. import symbol as sym
+
+
+def get_symbol(num_classes=1000, **kwargs):
+    data = sym.Variable("data")
+    # stage 1
+    net = sym.Convolution(data=data, kernel=(11, 11), stride=(4, 4),
+                          num_filter=96, name="conv1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.LRN(data=net, alpha=1e-4, beta=0.75, knorm=2, nsize=5)
+    net = sym.Pooling(data=net, pool_type="max", kernel=(3, 3), stride=(2, 2))
+    # stage 2
+    net = sym.Convolution(data=net, kernel=(5, 5), pad=(2, 2),
+                          num_filter=256, name="conv2")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.LRN(data=net, alpha=1e-4, beta=0.75, knorm=2, nsize=5)
+    net = sym.Pooling(data=net, pool_type="max", kernel=(3, 3), stride=(2, 2))
+    # stage 3
+    net = sym.Convolution(data=net, kernel=(3, 3), pad=(1, 1),
+                          num_filter=384, name="conv3")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Convolution(data=net, kernel=(3, 3), pad=(1, 1),
+                          num_filter=384, name="conv4")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Convolution(data=net, kernel=(3, 3), pad=(1, 1),
+                          num_filter=256, name="conv5")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Pooling(data=net, pool_type="max", kernel=(3, 3), stride=(2, 2))
+    # classifier
+    net = sym.Flatten(data=net)
+    net = sym.FullyConnected(data=net, num_hidden=4096, name="fc1")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Dropout(data=net, p=0.5)
+    net = sym.FullyConnected(data=net, num_hidden=4096, name="fc2")
+    net = sym.Activation(data=net, act_type="relu")
+    net = sym.Dropout(data=net, p=0.5)
+    net = sym.FullyConnected(data=net, num_hidden=num_classes, name="fc3")
+    return sym.SoftmaxOutput(data=net, name="softmax")

@@ -1,0 +1,137 @@
+"""ResNet v1 (He et al. 2015) and v2 pre-activation (He et al. 2016).
+
+Capability parity with the reference's
+``example/image-classification/symbols/resnet.py`` (which implements the
+pre-activation variant): depths 18/34/50/101/152/200 for ImageNet-shaped
+inputs, plus the CIFAR 6n+2 form when ``image_shape`` is small.
+"""
+from .. import symbol as sym
+
+_IMAGENET_UNITS = {
+    18: ([2, 2, 2, 2], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+    200: ([3, 24, 36, 3], True),
+}
+
+
+def _bn(net, name):
+    return sym.BatchNorm(data=net, fix_gamma=False, eps=2e-5, momentum=0.9,
+                         name=name)
+
+
+def residual_unit(data, num_filter, stride, dim_match, name,
+                  bottleneck=True, version=2):
+    """One residual unit.  v2 = BN-relu-conv preact; v1 = conv-BN-relu."""
+    if version == 2:
+        bn1 = _bn(data, name + "_bn1")
+        act1 = sym.Activation(data=bn1, act_type="relu")
+        if bottleneck:
+            c1 = sym.Convolution(data=act1, num_filter=num_filter // 4,
+                                 kernel=(1, 1), no_bias=True,
+                                 name=name + "_conv1")
+            bn2 = _bn(c1, name + "_bn2")
+            act2 = sym.Activation(data=bn2, act_type="relu")
+            c2 = sym.Convolution(data=act2, num_filter=num_filter // 4,
+                                 kernel=(3, 3), stride=stride, pad=(1, 1),
+                                 no_bias=True, name=name + "_conv2")
+            bn3 = _bn(c2, name + "_bn3")
+            act3 = sym.Activation(data=bn3, act_type="relu")
+            body = sym.Convolution(data=act3, num_filter=num_filter,
+                                   kernel=(1, 1), no_bias=True,
+                                   name=name + "_conv3")
+        else:
+            c1 = sym.Convolution(data=act1, num_filter=num_filter,
+                                 kernel=(3, 3), stride=stride, pad=(1, 1),
+                                 no_bias=True, name=name + "_conv1")
+            bn2 = _bn(c1, name + "_bn2")
+            act2 = sym.Activation(data=bn2, act_type="relu")
+            body = sym.Convolution(data=act2, num_filter=num_filter,
+                                   kernel=(3, 3), pad=(1, 1), no_bias=True,
+                                   name=name + "_conv2")
+        if dim_match:
+            shortcut = data
+        else:
+            shortcut = sym.Convolution(data=act1, num_filter=num_filter,
+                                       kernel=(1, 1), stride=stride,
+                                       no_bias=True, name=name + "_sc")
+        return body + shortcut
+    # v1
+    if bottleneck:
+        c1 = sym.Convolution(data=data, num_filter=num_filter // 4,
+                             kernel=(1, 1), no_bias=True,
+                             name=name + "_conv1")
+        b1 = _bn(c1, name + "_bn1")
+        a1 = sym.Activation(data=b1, act_type="relu")
+        c2 = sym.Convolution(data=a1, num_filter=num_filter // 4,
+                             kernel=(3, 3), stride=stride, pad=(1, 1),
+                             no_bias=True, name=name + "_conv2")
+        b2 = _bn(c2, name + "_bn2")
+        a2 = sym.Activation(data=b2, act_type="relu")
+        c3 = sym.Convolution(data=a2, num_filter=num_filter, kernel=(1, 1),
+                             no_bias=True, name=name + "_conv3")
+        body = _bn(c3, name + "_bn3")
+    else:
+        c1 = sym.Convolution(data=data, num_filter=num_filter, kernel=(3, 3),
+                             stride=stride, pad=(1, 1), no_bias=True,
+                             name=name + "_conv1")
+        b1 = _bn(c1, name + "_bn1")
+        a1 = sym.Activation(data=b1, act_type="relu")
+        c2 = sym.Convolution(data=a1, num_filter=num_filter, kernel=(3, 3),
+                             pad=(1, 1), no_bias=True, name=name + "_conv2")
+        body = _bn(c2, name + "_bn2")
+    if dim_match:
+        shortcut = data
+    else:
+        sc = sym.Convolution(data=data, num_filter=num_filter, kernel=(1, 1),
+                             stride=stride, no_bias=True, name=name + "_sc")
+        shortcut = _bn(sc, name + "_sc_bn")
+    return sym.Activation(data=body + shortcut, act_type="relu")
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape=(3, 224, 224),
+               version=2, **kwargs):
+    small_image = image_shape[-1] <= 64
+    data = sym.Variable("data")
+    if small_image:
+        # CIFAR form: 6n+2 layers, 3 stages of n non-bottleneck units
+        if (num_layers - 2) % 6 != 0:
+            raise ValueError("cifar resnet depth must be 6n+2")
+        n = (num_layers - 2) // 6
+        units, bottleneck = [n, n, n], False
+        filters = [16, 32, 64]
+        body = sym.Convolution(data=data, num_filter=16, kernel=(3, 3),
+                               pad=(1, 1), no_bias=True, name="conv0")
+    else:
+        if num_layers not in _IMAGENET_UNITS:
+            raise ValueError("resnet depth must be one of %s"
+                             % sorted(_IMAGENET_UNITS))
+        units, bottleneck = _IMAGENET_UNITS[num_layers]
+        filters = ([256, 512, 1024, 2048] if bottleneck
+                   else [64, 128, 256, 512])
+        body = sym.Convolution(data=data, num_filter=64, kernel=(7, 7),
+                               stride=(2, 2), pad=(3, 3), no_bias=True,
+                               name="conv0")
+        body = _bn(body, "bn0")
+        body = sym.Activation(data=body, act_type="relu")
+        body = sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                           pad=(1, 1), pool_type="max")
+    for i, (nu, nf) in enumerate(zip(units, filters)):
+        first_stride = (1, 1) if i == 0 and not small_image else \
+            ((1, 1) if i == 0 else (2, 2))
+        body = residual_unit(body, nf, first_stride, False,
+                             "stage%d_unit1" % (i + 1), bottleneck, version)
+        for j in range(1, nu):
+            body = residual_unit(body, nf, (1, 1), True,
+                                 "stage%d_unit%d" % (i + 1, j + 1),
+                                 bottleneck, version)
+    if version == 2:
+        body = _bn(body, "bn_final")
+        body = sym.Activation(data=body, act_type="relu")
+    pool = sym.Pooling(data=body, global_pool=True, pool_type="avg",
+                       kernel=(7, 7), name="pool_final")
+    flat = sym.Flatten(data=pool)
+    fc = sym.FullyConnected(data=flat, num_hidden=num_classes, name="fc1")
+    return sym.SoftmaxOutput(data=fc, name="softmax")

@@ -29,7 +29,10 @@ from .registry import Param, register, alias
 
 
 def _acc(dt):
-    return jnp.float32 if dt in (jnp.bfloat16, jnp.float16) else None
+    # bf16 matmuls/convs accumulate in f32 on the MXU natively; asking for
+    # preferred_element_type=f32 breaks lax's conv transpose rule under
+    # vjp (f32 cotangent vs bf16 operand), so never request promotion.
+    return None
 
 
 # ----------------------------------------------------------------------

@@ -149,6 +149,9 @@ class Trainer:
                 batch = {n: (v.astype(compute_dtype)
                              if jnp.issubdtype(v.dtype, jnp.floating) else v)
                          for n, v in batch.items()}
+                aux_vals = [(v.astype(compute_dtype)
+                             if jnp.issubdtype(v.dtype, jnp.floating) else v)
+                            for v in aux_vals]
             vals = [params[n] if n in param_set else batch[n]
                     for n in arg_names]
             outs, new_aux = prog._eval(vals, list(aux_vals), key, is_train)
@@ -166,6 +169,11 @@ class Trainer:
             grads = vjp(cot)[0]
             grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
             new_params, new_state = update_fn(params, grads, opt_state, lr, t)
+            # aux (BN moving stats) keep fp32 master copies like params do
+            new_aux = tuple(
+                v.astype(jnp.float32)
+                if jnp.issubdtype(v.dtype, jnp.floating) else v
+                for v in new_aux)
             return (new_params, dict(zip(aux_names, new_aux)), new_state,
                     tuple(o.astype(jnp.float32) for o in outs))
 

@@ -1,0 +1,64 @@
+"""Model zoo tests: shape inference across the zoo + a compiled train
+step on the smallest convnet (reference style: tests/python/train)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+
+@pytest.mark.parametrize("name,shape", [
+    ("mlp", (2, 1, 28, 28)),
+    ("lenet", (2, 1, 28, 28)),
+    ("alexnet", (2, 3, 224, 224)),
+    ("vgg-11", (2, 3, 224, 224)),
+    ("resnet-18", (2, 3, 224, 224)),
+    ("resnet-50", (2, 3, 224, 224)),
+    ("inception-bn", (2, 3, 224, 224)),
+    ("inception-v3", (2, 3, 299, 299)),
+])
+def test_model_shapes(name, shape):
+    sym = models.get_symbol(name, num_classes=10)
+    _, out_shapes, _ = sym.infer_shape(data=shape)
+    assert out_shapes[0] == (shape[0], 10)
+
+
+def test_cifar_resnet_shape():
+    sym = models.resnet.get_symbol(num_classes=10, num_layers=20,
+                                   image_shape=(3, 32, 32))
+    _, out_shapes, _ = sym.infer_shape(data=(4, 3, 32, 32))
+    assert out_shapes[0] == (4, 10)
+
+
+def test_lstm_lm_bucketing_symbols():
+    gen = models.lstm_lm.sym_gen_factory(num_hidden=8, num_embed=8,
+                                         num_layers=1, vocab_size=30)
+    for seq_len in (5, 10):
+        sym, data_names, label_names = gen(seq_len)
+        _, out_shapes, _ = sym.infer_shape(
+            data=(2, seq_len), softmax_label=(2, seq_len))
+        assert out_shapes[0] == (2 * seq_len, 30)
+
+
+def test_trainer_step_resnet_tiny():
+    from mxnet_tpu.parallel import Trainer
+    sym = models.resnet.get_symbol(num_classes=4, num_layers=8,
+                                   image_shape=(3, 8, 8))
+    t = Trainer(sym, mx.optimizer.SGD(learning_rate=0.1),
+                compute_dtype="bfloat16")
+    t.bind(data_shapes={"data": (4, 3, 8, 8)},
+           label_shapes={"softmax_label": (4,)})
+    t.init_params(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    batch = {"data": rng.normal(0, 1, (4, 3, 8, 8)).astype(np.float32),
+             "softmax_label": np.array([0, 1, 2, 3], np.float32)}
+    out0 = t.step(batch)[0].asnumpy()
+    assert out0.shape == (4, 4)
+    assert np.isfinite(out0).all()
+    # loss should drop over a few steps on a memorizable batch
+    def nll(out):
+        return -np.log(out[np.arange(4), [0, 1, 2, 3]] + 1e-8).mean()
+    first = nll(out0)
+    for _ in range(10):
+        out = t.step(batch)[0].asnumpy()
+    assert nll(out) < first
