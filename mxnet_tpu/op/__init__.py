@@ -8,3 +8,6 @@ from . import tensor  # noqa: F401
 from . import init_ops  # noqa: F401
 from . import optimizer_op  # noqa: F401
 from . import nn  # noqa: F401
+from . import vision  # noqa: F401
+from . import contrib  # noqa: F401
+from . import rnn_op  # noqa: F401

@@ -1,0 +1,459 @@
+"""Contrib ops: SSD MultiBox family, Faster-RCNN Proposal, count_sketch,
+fft/ifft.
+
+Reference: ``src/operator/contrib/multibox_{prior,target,detection}-inl.h``,
+``proposal-inl.h``, ``count_sketch-inl.h``, ``fft-inl.h`` (CUDA there).
+TPU design: everything is static-shape — NMS is a fixed-length
+suppression scan (``lax``-friendly), matching/sorting are vectorized, and
+invalid slots are encoded as ``-1`` rows exactly like the reference pads
+its outputs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import Param, register, _REGISTRY
+
+
+# ----------------------------------------------------------------------
+# MultiBoxPrior
+@register("MultiBoxPrior",
+          params_spec=(Param("sizes", "floats", (1.0,)),
+                       Param("ratios", "floats", (1.0,)),
+                       Param("clip", bool, False),
+                       Param("steps", "floats", (-1.0, -1.0)),
+                       Param("offsets", "floats", (0.5, 0.5))),
+          hint="multiboxprior")
+def _multibox_prior(p, c, data):
+    sizes, ratios = p["sizes"], p["ratios"]
+    steps, offsets = p["steps"], p["offsets"]
+    H, W = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H,W)
+    # anchors: num_sizes + num_ratios - 1 per pixel (reference rule:
+    # (s_i, r_0) for all sizes then (s_0, r_j) for j>0)
+    whs = [(sizes[i] * np.sqrt(ratios[0]), sizes[i] / np.sqrt(ratios[0]))
+           for i in range(len(sizes))]
+    whs += [(sizes[0] * np.sqrt(ratios[j]), sizes[0] / np.sqrt(ratios[j]))
+            for j in range(1, len(ratios))]
+    boxes = []
+    for w, h in whs:
+        boxes.append(jnp.stack([cxg - w / 2, cyg - h / 2,
+                                cxg + w / 2, cyg + h / 2], axis=-1))
+    out = jnp.stack(boxes, axis=2).reshape(1, H * W * len(whs), 4)
+    if p["clip"]:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(data.dtype)
+
+
+def _mbp_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    na = len(p["sizes"]) + len(p["ratios"]) - 1
+    return [tuple(d)], [(1, d[2] * d[3] * na, 4)], []
+
+
+_REGISTRY["MultiBoxPrior"].infer_shape = _mbp_infer_shape
+
+
+# ----------------------------------------------------------------------
+def _iou_matrix(a, b):
+    """a (A,4), b (M,4) corner boxes → IoU (A,M)."""
+    ax1, ay1, ax2, ay2 = a[:, 0], a[:, 1], a[:, 2], a[:, 3]
+    bx1, by1, bx2, by2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    iw = jnp.maximum(0.0, jnp.minimum(ax2[:, None], bx2[None]) -
+                     jnp.maximum(ax1[:, None], bx1[None]))
+    ih = jnp.maximum(0.0, jnp.minimum(ay2[:, None], by2[None]) -
+                     jnp.maximum(ay1[:, None], by1[None]))
+    inter = iw * ih
+    area_a = jnp.maximum(0.0, ax2 - ax1) * jnp.maximum(0.0, ay2 - ay1)
+    area_b = jnp.maximum(0.0, bx2 - bx1) * jnp.maximum(0.0, by2 - by1)
+    union = area_a[:, None] + area_b[None] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _encode_loc(anchors, gt, variances):
+    """Center-form offset targets (reference multibox_target-inl.h)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    gw = jnp.maximum(gt[:, 2] - gt[:, 0], 1e-8)
+    gh = jnp.maximum(gt[:, 3] - gt[:, 1], 1e-8)
+    gcx = (gt[:, 0] + gt[:, 2]) / 2
+    gcy = (gt[:, 1] + gt[:, 3]) / 2
+    tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / variances[0]
+    ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / variances[1]
+    tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / variances[2]
+    th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / variances[3]
+    return jnp.stack([tx, ty, tw, th], axis=-1)
+
+
+@register("MultiBoxTarget",
+          params_spec=(Param("overlap_threshold", float, 0.5),
+                       Param("ignore_label", float, -1.0),
+                       Param("negative_mining_ratio", float, -1.0),
+                       Param("negative_mining_thresh", float, 0.5),
+                       Param("minimum_negative_samples", int, 0),
+                       Param("variances", "floats", (0.1, 0.1, 0.2, 0.2))),
+          input_names=("anchor", "label", "cls_pred"), num_outputs=3,
+          output_names=lambda p: ["loc_target", "loc_mask", "cls_target"],
+          hint="multiboxtarget")
+def _multibox_target(p, c, anchor, label, cls_pred):
+    """Anchor→gt matching: greedy bipartite for each gt, then IoU-threshold
+    for the rest; optional hard-negative mining ranked by max non-background
+    confidence.  All static-shape (scan over the padded gt slots)."""
+    variances = p["variances"]
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    N, M = label.shape[0], label.shape[1]
+    thresh = p["overlap_threshold"]
+
+    def one_sample(lab, pred):
+        cls_id = lab[:, 0]                       # (M,) -1 = pad
+        gt = lab[:, 1:5]
+        valid_gt = cls_id >= 0
+        iou = _iou_matrix(anchors, gt)           # (A,M)
+        iou = jnp.where(valid_gt[None], iou, -1.0)
+
+        # greedy bipartite: M rounds, each picks the global argmax pair
+        def body(carry, _):
+            iou_m, match = carry                 # match (A,) gt idx or -1
+            flat = jnp.argmax(iou_m)
+            ai, mi = flat // M, flat % M
+            ok = iou_m[ai, mi] > 1e-12
+            match = jnp.where(ok, match.at[ai].set(mi), match)
+            iou_m = jnp.where(ok, iou_m.at[ai, :].set(-1.0), iou_m)
+            iou_m = jnp.where(ok, iou_m.at[:, mi].set(-1.0), iou_m)
+            return (iou_m, match), None
+
+        (iou_left, match), _ = lax.scan(
+            body, (iou, jnp.full((A,), -1, jnp.int32)), None, length=M)
+        # threshold matching for unmatched anchors (original iou)
+        best_m = jnp.argmax(iou, axis=1).astype(jnp.int32)
+        best_iou = jnp.max(iou, axis=1)
+        match = jnp.where((match < 0) & (best_iou >= thresh), best_m, match)
+
+        matched = match >= 0
+        mi = jnp.clip(match, 0, M - 1)
+        loc_t = _encode_loc(anchors, gt[mi], variances)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0)
+        loc_m = jnp.where(matched[:, None], 1.0, 0.0)
+        loc_m = jnp.broadcast_to(loc_m, (A, 4))
+        cls_t = jnp.where(matched, cls_id[mi] + 1.0, 0.0)
+
+        ratio = p["negative_mining_ratio"]
+        if ratio > 0:
+            # negatives are mineable only when their best IoU is below
+            # negative_mining_thresh (near-positives get ignore_label);
+            # rank by max non-background predicted prob
+            mineable = (~matched) & (best_iou < p["negative_mining_thresh"])
+            neg_conf = jnp.max(pred[1:], axis=0)      # pred (num_cls, A)
+            neg_conf = jnp.where(mineable, neg_conf, -jnp.inf)
+            order = jnp.argsort(-neg_conf)
+            rank = jnp.zeros((A,), jnp.int32).at[order].set(jnp.arange(A))
+            num_pos = jnp.sum(matched)
+            num_neg = jnp.maximum(
+                (ratio * num_pos).astype(jnp.int32),
+                p["minimum_negative_samples"])
+            keep_neg = mineable & (rank < num_neg)
+            cls_t = jnp.where(matched, cls_t,
+                              jnp.where(keep_neg, 0.0, p["ignore_label"]))
+        return loc_t.reshape(-1), loc_m.reshape(-1), cls_t
+
+    loc_t, loc_m, cls_t = jax.vmap(one_sample)(label, cls_pred)
+    return (loc_t.astype(anchor.dtype), loc_m.astype(anchor.dtype),
+            cls_t.astype(anchor.dtype))
+
+
+def _mbt_infer_shape(p, in_shapes):
+    a, l, _ = in_shapes
+    if a is None or l is None:
+        return None
+    A = a[1]
+    N = l[0]
+    return [tuple(s) for s in in_shapes], \
+        [(N, A * 4), (N, A * 4), (N, A)], []
+
+
+_REGISTRY["MultiBoxTarget"].infer_shape = _mbt_infer_shape
+
+
+# ----------------------------------------------------------------------
+def _decode_boxes(anchors, loc, variances):
+    """Inverse of _encode_loc: loc (A,4) offsets → corner boxes (A,4)."""
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    cx = loc[:, 0] * variances[0] * aw + acx
+    cy = loc[:, 1] * variances[1] * ah + acy
+    w = jnp.exp(loc[:, 2] * variances[2]) * aw
+    h = jnp.exp(loc[:, 3] * variances[3]) * ah
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+
+
+def _nms_keep(boxes, scores, iou_thresh, max_steps, force=None, cls=None):
+    """Static-shape greedy NMS: ``max_steps`` suppression rounds.  Returns
+    a keep mask.  ``force=False`` + ``cls`` restricts suppression to the
+    same class (reference force_suppress=False semantics)."""
+    A = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes)
+    if force is False and cls is not None:
+        same = cls[:, None] == cls[None, :]
+        iou = jnp.where(same, iou, 0.0)
+
+    def body(carry, _):
+        avail, keep = carry
+        s = jnp.where(avail, scores, -jnp.inf)
+        i = jnp.argmax(s)
+        ok = s[i] > -jnp.inf
+        keep = jnp.where(ok, keep.at[i].set(True), keep)
+        suppress = iou[i] > iou_thresh
+        avail = avail & ~suppress & (jnp.arange(A) != i)
+        avail = jnp.where(ok, avail, jnp.zeros_like(avail))
+        return (avail, keep), None
+
+    avail0 = scores > -jnp.inf
+    (___, keep), _ = lax.scan(
+        body, (avail0, jnp.zeros((A,), bool)), None, length=max_steps)
+    return keep
+
+
+@register("MultiBoxDetection",
+          params_spec=(Param("clip", bool, True),
+                       Param("threshold", float, 0.01),
+                       Param("background_id", int, 0),
+                       Param("nms_threshold", float, 0.5),
+                       Param("force_suppress", bool, False),
+                       Param("variances", "floats", (0.1, 0.1, 0.2, 0.2)),
+                       Param("nms_topk", int, -1)),
+          input_names=("cls_prob", "loc_pred", "anchor"),
+          hint="multiboxdetection")
+def _multibox_detection(p, c, cls_prob, loc_pred, anchor):
+    """Decode + NMS → (N, A, 6) rows [cls_id, score, x1, y1, x2, y2];
+    suppressed/invalid rows have cls_id = -1 (reference layout)."""
+    variances = p["variances"]
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    bg = p["background_id"]
+    steps = p["nms_topk"] if p["nms_topk"] > 0 else min(A, 400)
+
+    def one(prob, loc):
+        # prob (num_cls, A): winning foreground class per anchor
+        prob_fg = prob.at[bg].set(-1.0)
+        cls = jnp.argmax(prob_fg, axis=0).astype(jnp.float32)
+        score = jnp.max(prob_fg, axis=0)
+        boxes = _decode_boxes(anchors, loc.reshape(A, 4), variances)
+        if p["clip"]:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        valid = score > p["threshold"]
+        s = jnp.where(valid, score, -jnp.inf)
+        keep = _nms_keep(boxes, s, p["nms_threshold"], steps,
+                         force=p["force_suppress"], cls=cls)
+        out_id = jnp.where(cls > bg, cls - 1.0, cls)
+        out_cls = jnp.where(keep, out_id, -1.0)
+        row = jnp.concatenate([out_cls[:, None], score[:, None], boxes], -1)
+        # sort kept rows first by score
+        order = jnp.argsort(jnp.where(keep, -score, jnp.inf))
+        return row[order]
+
+    return jax.vmap(one)(cls_prob, loc_pred).astype(cls_prob.dtype)
+
+
+def _mbd_infer_shape(p, in_shapes):
+    cp = in_shapes[0]
+    if cp is None:
+        return None
+    return [tuple(s) for s in in_shapes], [(cp[0], cp[2], 6)], []
+
+
+_REGISTRY["MultiBoxDetection"].infer_shape = _mbd_infer_shape
+
+
+# ----------------------------------------------------------------------
+@register("Proposal",
+          params_spec=(Param("rpn_pre_nms_top_n", int, 6000),
+                       Param("rpn_post_nms_top_n", int, 300),
+                       Param("threshold", float, 0.7),
+                       Param("rpn_min_size", int, 16),
+                       Param("scales", "floats", (4.0, 8.0, 16.0, 32.0)),
+                       Param("ratios", "floats", (0.5, 1.0, 2.0)),
+                       Param("feature_stride", int, 16),
+                       Param("output_score", bool, False),
+                       Param("iou_loss", bool, False)),
+          input_names=("cls_prob", "bbox_pred", "im_info"),
+          num_outputs=lambda p: 2 if p.get("output_score") else 1,
+          output_names=lambda p: (["output", "score"]
+                                  if p.get("output_score") else ["output"]),
+          hint="proposal")
+def _proposal(p, c, cls_prob, bbox_pred, im_info):
+    """RPN proposal op (reference ``contrib/proposal-inl.h``): enumerate
+    anchors on the feature grid, decode deltas, clip, drop boxes smaller
+    than min_size, top-k by score, NMS, pad to post_nms_top_n."""
+    scales, ratios = p["scales"], p["ratios"]
+    stride = p["feature_stride"]
+    N, _, H, W = cls_prob.shape
+    K = len(scales) * len(ratios)
+    post_n = p["rpn_post_nms_top_n"]
+    pre_n = p["rpn_pre_nms_top_n"]
+
+    # base anchors around a stride×stride cell (centered)
+    base = []
+    csz = stride
+    cx = (csz - 1) / 2.0
+    for r in ratios:
+        size = csz * csz / r
+        ws = np.round(np.sqrt(size))
+        hs = np.round(ws * r)
+        for s in scales:
+            w2, h2 = ws * s, hs * s
+            base.append([cx - (w2 - 1) / 2, cx - (h2 - 1) / 2,
+                         cx + (w2 - 1) / 2, cx + (h2 - 1) / 2])
+    base = jnp.asarray(np.array(base, np.float32))  # (K,4)
+    sx = jnp.arange(W, dtype=jnp.float32) * stride
+    sy = jnp.arange(H, dtype=jnp.float32) * stride
+    syg, sxg = jnp.meshgrid(sy, sx, indexing="ij")
+    shift = jnp.stack([sxg, syg, sxg, syg], -1).reshape(H * W, 1, 4)
+    anchors = (shift + base[None]).reshape(-1, 4)  # (H*W*K,4)
+    A = anchors.shape[0]
+
+    def one(prob, deltas, info):
+        # prob (2K,H,W): second half is foreground; deltas (4K,H,W)
+        fg = prob[K:].transpose(1, 2, 0).reshape(-1)         # (H*W*K,)
+        dl = deltas.reshape(K, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        im_h, im_w = info[0], info[1]
+        # decode (cx/cy/w/h deltas, unit variances)
+        boxes = _decode_boxes(
+            jnp.stack([anchors[:, 0], anchors[:, 1],
+                       anchors[:, 2] + 1.0, anchors[:, 3] + 1.0], -1),
+            dl, (1.0, 1.0, 1.0, 1.0))
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, im_w - 1),
+            jnp.clip(boxes[:, 1], 0, im_h - 1),
+            jnp.clip(boxes[:, 2], 0, im_w - 1),
+            jnp.clip(boxes[:, 3], 0, im_h - 1)], -1)
+        min_size = p["rpn_min_size"] * info[2]
+        wv = boxes[:, 2] - boxes[:, 0] + 1
+        hv = boxes[:, 3] - boxes[:, 1] + 1
+        valid = (wv >= min_size) & (hv >= min_size)
+        score = jnp.where(valid, fg, -jnp.inf)
+        # pre-nms top-k
+        k = min(pre_n, A)
+        top_s, top_i = lax.top_k(score, k)
+        top_b = boxes[top_i]
+        keep = _nms_keep(top_b, top_s, p["threshold"], min(post_n, k))
+        # kept rows first (score order); pad slots cycle the kept set,
+        # matching the reference (proposal keep[i % out_size] padding)
+        order = jnp.argsort(jnp.where(keep, -top_s, jnp.inf))
+        num_keep = jnp.maximum(jnp.sum(keep), 1)
+        slot = jnp.arange(post_n) % num_keep
+        src_idx = order[jnp.clip(slot, 0, k - 1)]
+        return top_b[src_idx], top_s[src_idx]
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.broadcast_to(
+        jnp.arange(N, dtype=cls_prob.dtype)[:, None], (N, post_n))
+    rois = jnp.concatenate([batch_idx[..., None], boxes], -1) \
+        .reshape(N * post_n, 5)
+    if p["output_score"]:
+        return rois, scores.reshape(N * post_n, 1)
+    return rois
+
+
+def _proposal_infer_shape(p, in_shapes):
+    cp = in_shapes[0]
+    if cp is None:
+        return None
+    N = cp[0]
+    post_n = p["rpn_post_nms_top_n"]
+    outs = [(N * post_n, 5)]
+    if p["output_score"]:
+        outs.append((N * post_n, 1))
+    return [tuple(s) for s in in_shapes], outs, []
+
+
+_REGISTRY["Proposal"].infer_shape = _proposal_infer_shape
+from .registry import alias  # noqa: E402
+alias("_contrib_Proposal", "Proposal")
+alias("_contrib_MultiBoxPrior", "MultiBoxPrior")
+alias("_contrib_MultiBoxTarget", "MultiBoxTarget")
+alias("_contrib_MultiBoxDetection", "MultiBoxDetection")
+
+
+# ----------------------------------------------------------------------
+@register("count_sketch",
+          params_spec=(Param("out_dim", int, required=True),
+                       Param("processing_batch_size", int, 32)),
+          input_names=("data", "h", "s"), hint="countsketch")
+def _count_sketch(p, c, data, h, s):
+    """Count-sketch projection (reference ``contrib/count_sketch-inl.h``):
+    out[n, h[j]] += s[j] * data[n, j] — one XLA scatter-add."""
+    out_dim = p["out_dim"]
+    n = data.shape[0]
+    idx = jnp.clip(h.reshape(-1).astype(jnp.int32), 0, out_dim - 1)
+    vals = data * s.reshape(1, -1).astype(data.dtype)
+    out = jnp.zeros((n, out_dim), data.dtype)
+    return out.at[:, idx].add(vals)
+
+
+def _cs_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    return [tuple(s) for s in in_shapes], [(d[0], p["out_dim"])], []
+
+
+_REGISTRY["count_sketch"].infer_shape = _cs_infer_shape
+alias("_contrib_count_sketch", "count_sketch")
+
+
+@register("fft", params_spec=(Param("compute_size", int, 128),),
+          hint="fft")
+def _fft(p, c, data):
+    """FFT over the last axis; complex output interleaved [re, im] so the
+    result is a real array of twice the width (reference contrib/fft
+    output layout, which cuFFT produced)."""
+    z = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([z.real, z.imag], axis=-1)
+    return out.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(data.dtype)
+
+
+@register("ifft", params_spec=(Param("compute_size", int, 128),),
+          hint="ifft")
+def _ifft(p, c, data):
+    d = data.shape[-1] // 2
+    z = data.reshape(data.shape[:-1] + (d, 2))
+    comp = z[..., 0] + 1j * z[..., 1]
+    # reference ifft is unnormalized (cuFFT): scale by d to match
+    return (jnp.fft.ifft(comp, axis=-1).real * d).astype(data.dtype)
+
+
+def _fft_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    return [tuple(d)], [tuple(d[:-1]) + (2 * d[-1],)], []
+
+
+def _ifft_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    return [tuple(d)], [tuple(d[:-1]) + (d[-1] // 2,)], []
+
+
+_REGISTRY["fft"].infer_shape = _fft_infer_shape
+_REGISTRY["ifft"].infer_shape = _ifft_infer_shape
+alias("_contrib_fft", "fft")
+alias("_contrib_ifft", "ifft")

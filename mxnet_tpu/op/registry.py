@@ -71,6 +71,17 @@ def _parse_dtype(v):
     return _dtype(v)
 
 
+def _parse_floats(v):
+    """Tuple-of-floats params ('(0.1, 0.2)' strings, scalars, sequences)."""
+    if v is None:
+        return None
+    if isinstance(v, str):
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, float, np.floating, np.integer)):
+        return (float(v),)
+    return tuple(float(x) for x in v)
+
+
 _COERCE = {
     int: lambda v: int(float(v)) if isinstance(v, str) else int(v),
     float: float,
@@ -78,6 +89,7 @@ _COERCE = {
     str: str,
     "shape": _parse_shape,
     "dtype": _parse_dtype,
+    "floats": _parse_floats,
 }
 
 

@@ -1,0 +1,191 @@
+"""Fused ``RNN`` operator.
+
+The reference's ``RNN`` op is cuDNN-only — its CPU path aborts
+(``src/operator/rnn.cc:14``, ``rnn-inl.h:302``).  The TPU-native design:
+the input projection for ALL timesteps is one large MXU matmul per layer,
+and only the recurrent half runs under ``lax.scan`` — so the sequential
+part is minimal and everything else tiles onto the systolic array.
+
+Packed parameter layout (matches :class:`mxnet_tpu.rnn.FusedRNNCell`
+weight naming, so pack/unpack round-trips): for each layer then each
+direction, ``i2h_weight`` then ``h2h_weight`` (row-major flattened), then
+for each layer/direction ``i2h_bias`` then ``h2h_bias``.  Gate order:
+LSTM ``[i, f, c, o]``, GRU ``[r, z, n]`` (reset applied to the h2h
+branch, cuDNN convention), vanilla ``[h]``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import Param, register, _REGISTRY
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    """Total packed parameter count (the analog of cudnnGetRNNParamsSize)."""
+    G = _GATES[mode]
+    D = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * D
+        size += D * G * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def _unpack(params, mode, input_size, H, L, D):
+    """Split the flat parameter vector into per-(layer, dir) weight/bias."""
+    G = _GATES[mode]
+    ws, off = [], 0
+
+    def take(n, shape):
+        nonlocal off
+        w = lax.dynamic_slice(params, (off,), (n,)).reshape(shape)
+        off += n
+        return w
+
+    for layer in range(L):
+        in_sz = input_size if layer == 0 else H * D
+        per_dir = []
+        for d in range(D):
+            wi = take(G * H * in_sz, (G * H, in_sz))
+            wh = take(G * H * H, (G * H, H))
+            per_dir.append([wi, wh, None, None])
+        ws.append(per_dir)
+    for layer in range(L):
+        for d in range(D):
+            ws[layer][d][2] = take(G * H, (G * H,))
+            ws[layer][d][3] = take(G * H, (G * H,))
+    return ws
+
+
+def _cell_scan(mode, x_proj, wh, bh, h0, c0, reverse, clip=None):
+    """Scan the recurrent half over time.  x_proj (T,N,G*H) already holds
+    i2h @ x + i2h_bias for every step.  ``clip=(min,max)`` bounds the LSTM
+    cell state (the reference's lstm_state_clip_min/max)."""
+    H = h0.shape[-1]
+
+    if mode == "lstm":
+        def step(carry, xp):
+            h, cc = carry
+            gates = xp + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = (jax.nn.sigmoid(i), jax.nn.sigmoid(f),
+                       jax.nn.sigmoid(o))
+            g = jnp.tanh(g)
+            cn = f * cc + i * g
+            if clip is not None:
+                cn = jnp.clip(cn, clip[0], clip[1])
+            hn = o * jnp.tanh(cn)
+            return (hn, cn), hn
+        (hT, cT), out = lax.scan(step, (h0, c0), x_proj, reverse=reverse)
+        return out, hT, cT
+    if mode == "gru":
+        def step(h, xp):
+            hp = h @ wh.T + bh
+            xr, xz, xn = jnp.split(xp, 3, axis=-1)
+            hr, hz, hn_ = jnp.split(hp, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn_)
+            hn = (1 - z) * n + z * h
+            return hn, hn
+        hT, out = lax.scan(step, h0, x_proj, reverse=reverse)
+        return out, hT, None
+    act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+    def step(h, xp):
+        hn = act(xp + h @ wh.T + bh)
+        return hn, hn
+    hT, out = lax.scan(step, h0, x_proj, reverse=reverse)
+    return out, hT, None
+
+
+@register("RNN",
+          params_spec=(Param("state_size", int, required=True),
+                       Param("num_layers", int, required=True),
+                       Param("mode", str, required=True,
+                             enum=("rnn_relu", "rnn_tanh", "lstm", "gru")),
+                       Param("bidirectional", bool, False),
+                       Param("p", float, 0.0),
+                       Param("state_outputs", bool, False),
+                       Param("lstm_state_clip_min", float, 0.0),
+                       Param("lstm_state_clip_max", float, 0.0)),
+          input_names=lambda p: (["data", "parameters", "state", "state_cell"]
+                                 if p.get("mode") == "lstm"
+                                 else ["data", "parameters", "state"]),
+          num_outputs=lambda p: ((3 if p.get("mode") == "lstm" else 2)
+                                 if p.get("state_outputs") else 1),
+          output_names=lambda p: ((["output", "state", "state_cell"]
+                                   if p.get("mode") == "lstm"
+                                   else ["output", "state"])
+                                  if p.get("state_outputs") else ["output"]),
+          uses_rng=True, mode_dependent=True, hint="rnn")
+def _rnn(p, c, data, parameters, state, state_cell=None):
+    """data (T, N, input_size) TNC; state (L*D, N, H)."""
+    mode = p["mode"]
+    H = p["state_size"]
+    L = p["num_layers"]
+    D = 2 if p["bidirectional"] else 1
+    T, N, I = data.shape
+    ws = _unpack(parameters.reshape(-1), mode, I, H, L, D)
+    clip = None
+    if mode == "lstm" and (p["lstm_state_clip_min"] != 0.0
+                           or p["lstm_state_clip_max"] != 0.0):
+        clip = (p["lstm_state_clip_min"], p["lstm_state_clip_max"])
+
+    x = data
+    h_out, c_out = [], []
+    key = c.rng
+    for layer in range(L):
+        outs = []
+        for d in range(D):
+            wi, wh, bi, bh = ws[layer][d]
+            idx = layer * D + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            x_proj = (x.reshape(T * N, -1) @ wi.T + bi).reshape(T, N, -1)
+            out, hT, cT = _cell_scan(mode, x_proj, wh, bh, h0, c0,
+                                     reverse=(d == 1), clip=clip)
+            outs.append(out)
+            h_out.append(hT)
+            if mode == "lstm":
+                c_out.append(cT)
+        x = outs[0] if D == 1 else jnp.concatenate(outs, axis=-1)
+        if p["p"] > 0 and c.is_train and layer != L - 1:
+            key, sub = jax.random.split(key)
+            keep = jax.random.bernoulli(sub, 1 - p["p"], x.shape)
+            x = jnp.where(keep, x / (1 - p["p"]), 0.0).astype(x.dtype)
+    if not p["state_outputs"]:
+        return x
+    hN = jnp.stack(h_out, 0)
+    if mode == "lstm":
+        return x, hN, jnp.stack(c_out, 0)
+    return x, hN
+
+
+def _rnn_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    T, N, I = d
+    H, L = p["state_size"], p["num_layers"]
+    D = 2 if p["bidirectional"] else 1
+    psize = rnn_param_size(p["mode"], I, H, L, p["bidirectional"])
+    ins = [tuple(d), (psize,), (L * D, N, H)]
+    if p["mode"] == "lstm":
+        ins.append((L * D, N, H))
+    outs = [(T, N, H * D)]
+    if p["state_outputs"]:
+        outs.append((L * D, N, H))
+        if p["mode"] == "lstm":
+            outs.append((L * D, N, H))
+    return ins, outs, []
+
+
+_REGISTRY["RNN"].infer_shape = _rnn_infer_shape

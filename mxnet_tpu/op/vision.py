@@ -1,0 +1,266 @@
+"""Vision ops: SpatialTransformer family, ROIPooling, Correlation,
+imdecode-adjacent ops.
+
+Reference: ``src/operator/spatial_transformer-inl.h``,
+``grid_generator-inl.h``, ``bilinear_sampler-inl.h``,
+``roi_pooling-inl.h``, ``correlation-inl.h`` (CUDA kernels there; here
+each op is a vectorized XLA program — gathers/masked reductions instead
+of scalar loops, so the MXU/VPU tile them).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .registry import Param, register
+
+
+# ----------------------------------------------------------------------
+# bilinear sampling core (shared by BilinearSampler / SpatialTransformer)
+def _bilinear_gather(data, xs, ys):
+    """data (N,C,H,W); xs/ys (N,Ho,Wo) source pixel coords.  Zero padding
+    outside the image (reference bilinear_sampler-inl.h boundary rule)."""
+    N, C, H, W = data.shape
+    Ho, Wo = xs.shape[1:]
+    x0 = jnp.floor(xs)
+    y0 = jnp.floor(ys)
+    wx = (xs - x0)[:, None]  # (N,1,Ho,Wo)
+    wy = (ys - y0)[:, None]
+    flat = data.reshape(N, C, H * W)
+
+    def corner(yi, xi):
+        valid = ((xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1))
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        idx = (yc * W + xc).reshape(N, 1, Ho * Wo)
+        g = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (N, C, Ho * Wo)),
+                                axis=2).reshape(N, C, Ho, Wo)
+        return g * valid[:, None].astype(data.dtype)
+
+    g00 = corner(y0, x0)
+    g01 = corner(y0, x0 + 1)
+    g10 = corner(y0 + 1, x0)
+    g11 = corner(y0 + 1, x0 + 1)
+    top = g00 * (1 - wx) + g01 * wx
+    bot = g10 * (1 - wx) + g11 * wx
+    return top * (1 - wy) + bot * wy
+
+
+def _grid_to_coords(grid, H, W):
+    """grid (N,2,Ho,Wo) in [-1,1] (x then y, reference layout) to pixel
+    coordinates."""
+    xs = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    ys = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return xs, ys
+
+
+@register("BilinearSampler", input_names=("data", "grid"),
+          hint="bilinearsampler")
+def _bilinear_sampler(p, c, data, grid):
+    xs, ys = _grid_to_coords(grid, data.shape[2], data.shape[3])
+    return _bilinear_gather(data, xs, ys)
+
+
+def _affine_grid(theta, H, W):
+    """theta (N,6) → sampling grid (N,2,H,W) in [-1,1]."""
+    N = theta.shape[0]
+    yt, xt = jnp.meshgrid(jnp.linspace(-1, 1, H), jnp.linspace(-1, 1, W),
+                          indexing="ij")
+    ones = jnp.ones_like(xt)
+    coords = jnp.stack([xt, yt, ones], 0).reshape(3, H * W)
+    mat = theta.reshape(N, 2, 3).astype(coords.dtype)
+    out = jnp.einsum("nij,jk->nik", mat, coords)  # (N,2,H*W)
+    return out.reshape(N, 2, H, W)
+
+
+@register("GridGenerator",
+          params_spec=(Param("transform_type", str, required=True,
+                             enum=("affine", "warp")),
+                       Param("target_shape", "shape", (0, 0))),
+          hint="gridgenerator")
+def _grid_generator(p, c, data):
+    if p["transform_type"] == "affine":
+        H, W = p["target_shape"]
+        if H == 0 or W == 0:
+            raise MXNetError("GridGenerator affine needs target_shape")
+        return _affine_grid(data, H, W).astype(data.dtype)
+    # warp: data is an optical flow (N,2,H,W) in pixels; output normalized
+    N, _, H, W = data.shape
+    yt, xt = jnp.meshgrid(jnp.arange(H), jnp.arange(W), indexing="ij")
+    x = (data[:, 0] + xt) * (2.0 / max(W - 1, 1)) - 1.0
+    y = (data[:, 1] + yt) * (2.0 / max(H - 1, 1)) - 1.0
+    return jnp.stack([x, y], 1).astype(data.dtype)
+
+
+def _gg_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    if p["transform_type"] == "affine":
+        H, W = p["target_shape"]
+        return [tuple(d)], [(d[0], 2, H, W)], []
+    return [tuple(d)], [tuple(d)], []
+
+
+from .registry import _REGISTRY  # noqa: E402
+_REGISTRY["GridGenerator"].infer_shape = _gg_infer_shape
+
+
+@register("SpatialTransformer",
+          params_spec=(Param("target_shape", "shape", (0, 0)),
+                       Param("transform_type", str, "affine",
+                             enum=("affine",)),
+                       Param("sampler_type", str, "bilinear",
+                             enum=("bilinear",))),
+          input_names=("data", "loc"), hint="spatialtransformer")
+def _spatial_transformer(p, c, data, loc):
+    H, W = p["target_shape"]
+    if H == 0 or W == 0:
+        H, W = data.shape[2], data.shape[3]
+    grid = _affine_grid(loc.astype(jnp.float32), H, W)
+    xs, ys = _grid_to_coords(grid, data.shape[2], data.shape[3])
+    # coords stay f32 (bf16 spacing near 200px is a whole pixel)
+    return _bilinear_gather(data, xs, ys).astype(data.dtype)
+
+
+def _st_infer_shape(p, in_shapes):
+    d = in_shapes[0]
+    if d is None:
+        return None
+    H, W = p["target_shape"]
+    if H == 0 or W == 0:
+        H, W = d[2], d[3]
+    return [tuple(d), (d[0], 6)], [(d[0], d[1], H, W)], []
+
+
+_REGISTRY["SpatialTransformer"].infer_shape = _st_infer_shape
+
+
+# ----------------------------------------------------------------------
+@register("ROIPooling",
+          params_spec=(Param("pooled_size", "shape", required=True),
+                       Param("spatial_scale", float, required=True)),
+          input_names=("data", "rois"), hint="roipooling")
+def _roi_pooling(p, c, data, rois):
+    """Max pooling over roi bins (reference ``roi_pooling-inl.h``: rois are
+    ``[batch_idx, x1, y1, x2, y2]`` image coords scaled by spatial_scale,
+    inclusive; empty bins produce 0).  Masked-reduction formulation."""
+    PH, PW = p["pooled_size"]
+    scale = p["spatial_scale"]
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+    batch_idx = jnp.clip(rois[:, 0].astype(jnp.int32), 0, N - 1)
+    x1 = jnp.round(rois[:, 1] * scale)
+    y1 = jnp.round(rois[:, 2] * scale)
+    x2 = jnp.round(rois[:, 3] * scale)
+    y2 = jnp.round(rois[:, 4] * scale)
+    rw = jnp.maximum(x2 - x1 + 1.0, 1.0)  # (R,)
+    rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+    bin_h = rh / PH
+    bin_w = rw / PW
+
+    ph = jnp.arange(PH, dtype=data.dtype)
+    pw = jnp.arange(PW, dtype=data.dtype)
+    ys_ = jnp.floor(y1[:, None] + ph[None] * bin_h[:, None])        # (R,PH)
+    ye_ = jnp.ceil(y1[:, None] + (ph[None] + 1) * bin_h[:, None])
+    xs_ = jnp.floor(x1[:, None] + pw[None] * bin_w[:, None])        # (R,PW)
+    xe_ = jnp.ceil(x1[:, None] + (pw[None] + 1) * bin_w[:, None])
+
+    rows = jnp.arange(H, dtype=data.dtype)
+    cols = jnp.arange(W, dtype=data.dtype)
+    in_y = ((rows[None, None] >= ys_[..., None]) &
+            (rows[None, None] < ye_[..., None]))                    # (R,PH,H)
+    in_x = ((cols[None, None] >= xs_[..., None]) &
+            (cols[None, None] < xe_[..., None]))                    # (R,PW,W)
+
+    roi_data = jnp.take(data, batch_idx, axis=0)                    # (R,C,H,W)
+    neg = jnp.asarray(-jnp.inf, data.dtype)
+    # stage 1: masked max over W per pw  → (R,C,H,PW)
+    a = jnp.where(in_x[:, None, None, :, :],
+                  roi_data[:, :, :, None, :], neg).max(axis=-1)
+    # stage 2: masked max over H per ph → (R,C,PH,PW)
+    out = jnp.where(in_y[:, None, :, None, :],
+                    jnp.moveaxis(a, 2, -1)[:, :, None], neg).max(axis=-1)
+    return jnp.where(jnp.isfinite(out), out, 0.0).astype(data.dtype)
+
+
+def _roi_infer_shape(p, in_shapes):
+    d, r = in_shapes
+    if d is None or r is None:
+        return None
+    PH, PW = p["pooled_size"]
+    return [tuple(d), tuple(r)], [(r[0], d[1], PH, PW)], []
+
+
+_REGISTRY["ROIPooling"].infer_shape = _roi_infer_shape
+
+
+# ----------------------------------------------------------------------
+@register("Correlation",
+          params_spec=(Param("kernel_size", int, 1),
+                       Param("max_displacement", int, 1),
+                       Param("stride1", int, 1),
+                       Param("stride2", int, 1),
+                       Param("pad_size", int, 0),
+                       Param("is_multiply", bool, True)),
+          input_names=("data1", "data2"), num_outputs=1,
+          hint="correlation")
+def _correlation(p, c, data1, data2):
+    """FlowNet correlation layer (reference ``correlation-inl.h``): for each
+    displacement in a (2d+1)² neighbourhood, the patch dot product of
+    data1 and shifted data2.  Displacements are a static Python loop —
+    each is one fused multiply + window-sum XLA op."""
+    K = p["kernel_size"]
+    md = p["max_displacement"]
+    s1, s2, pad = p["stride1"], p["stride2"], p["pad_size"]
+    N, C, H, W = data1.shape
+    br = K // 2  # border needed for the kernel window
+    d = md // s2
+    D = 2 * d + 1
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    Hp, Wp = H + 2 * pad, W + 2 * pad
+    # output spatial extent (reference formula)
+    bsz = br + md
+    Ho = int(np.ceil((Hp - 2 * bsz) / s1))
+    Wo = int(np.ceil((Wp - 2 * bsz) / s1))
+    norm = float(K * K * C)
+    planes = []
+    for dy in range(-d, d + 1):
+        for dx in range(-d, d + 1):
+            oy, ox = dy * s2, dx * s2
+            sh2 = lax.slice(
+                p2, (0, 0, bsz + oy - br, bsz + ox - br),
+                (N, C, bsz + oy - br + (Ho - 1) * s1 + K,
+                 bsz + ox - br + (Wo - 1) * s1 + K))
+            sh1 = lax.slice(
+                p1, (0, 0, bsz - br, bsz - br),
+                (N, C, bsz - br + (Ho - 1) * s1 + K,
+                 bsz - br + (Wo - 1) * s1 + K))
+            prod = (sh1 * sh2) if p["is_multiply"] else jnp.abs(sh1 - sh2)
+            summed = lax.reduce_window(
+                prod, np.array(0, prod.dtype), lax.add,
+                (1, 1, K, K), (1, 1, s1, s1),
+                ((0, 0), (0, 0), (0, 0), (0, 0)))
+            planes.append(summed.sum(axis=1) / norm)      # (N,Ho,Wo)
+    return jnp.stack(planes, axis=1).astype(data1.dtype)  # (N,D²,Ho,Wo)
+
+
+def _corr_infer_shape(p, in_shapes):
+    d1 = in_shapes[0]
+    if d1 is None:
+        return None
+    K, md = p["kernel_size"], p["max_displacement"]
+    s1, s2, pad = p["stride1"], p["stride2"], p["pad_size"]
+    d = md // s2
+    D = 2 * d + 1
+    bsz = K // 2 + md
+    Ho = int(np.ceil((d1[2] + 2 * pad - 2 * bsz) / s1))
+    Wo = int(np.ceil((d1[3] + 2 * pad - 2 * bsz) / s1))
+    return [tuple(d1), tuple(d1)], [(d1[0], D * D, Ho, Wo)], []
+
+
+_REGISTRY["Correlation"].infer_shape = _corr_infer_shape

@@ -1,0 +1,220 @@
+"""Vision + contrib + fused-RNN op tests (reference style:
+tests/python/unittest/test_operator.py golden-value checks)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.op.registry import OpContext, get
+
+
+def _run(name, *arrays, **params):
+    op = get(name)
+    parsed = op.parse_params(params)
+    import jax
+    ctx = OpContext(is_train=False,
+                    rng=jax.random.key(0) if op.uses_rng else None)
+    import jax.numpy as jnp
+    outs, _ = op.apply(parsed, ctx, *[jnp.asarray(a) for a in arrays])
+    return [np.asarray(o) for o in outs]
+
+
+def test_bilinear_sampler_identity():
+    rng = np.random.RandomState(0)
+    data = rng.rand(2, 3, 5, 7).astype(np.float32)
+    # identity grid
+    ys, xs = np.meshgrid(np.linspace(-1, 1, 5), np.linspace(-1, 1, 7),
+                         indexing="ij")
+    grid = np.stack([xs, ys], 0)[None].repeat(2, axis=0).astype(np.float32)
+    out, = _run("BilinearSampler", data, grid)
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    rng = np.random.RandomState(1)
+    data = rng.rand(2, 2, 6, 6).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    out, = _run("SpatialTransformer", data, theta, target_shape=(6, 6))
+    np.testing.assert_allclose(out, data, rtol=1e-5, atol=1e-5)
+
+
+def test_grid_generator_affine_shape():
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (3, 1))
+    out, = _run("GridGenerator", theta, transform_type="affine",
+                target_shape=(4, 5))
+    assert out.shape == (3, 2, 4, 5)
+    # identity affine: x coords span [-1,1]
+    np.testing.assert_allclose(out[0, 0, 0], np.linspace(-1, 1, 5),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_roi_pooling_simple():
+    data = np.arange(1 * 1 * 4 * 4, dtype=np.float32).reshape(1, 1, 4, 4)
+    rois = np.array([[0, 0, 0, 3, 3]], np.float32)  # whole image
+    out, = _run("ROIPooling", data, rois, pooled_size=(2, 2),
+                spatial_scale=1.0)
+    expect = np.array([[[[5, 7], [13, 15]]]], np.float32)
+    np.testing.assert_allclose(out, expect)
+
+
+def test_correlation_self():
+    rng = np.random.RandomState(2)
+    d = rng.rand(1, 4, 6, 6).astype(np.float32)
+    out, = _run("Correlation", d, d, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=1)
+    assert out.shape == (1, 9, 6, 6)
+    # center displacement of self-correlation = mean over channels of d*d
+    center = out[0, 4]
+    np.testing.assert_allclose(center[1:-1, 1:-1],
+                               (d[0] ** 2).mean(0)[1:-1, 1:-1],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_multibox_prior():
+    data = np.zeros((1, 3, 4, 4), np.float32)
+    out, = _run("MultiBoxPrior", data, sizes="(0.5,)", ratios="(1.0, 2.0)")
+    assert out.shape == (1, 4 * 4 * 2, 4)
+    # first anchor: centered at (0.5+0)/4 with size 0.5
+    b = out[0, 0]
+    c = (0.5 / 4)
+    np.testing.assert_allclose(b, [c - .25, c - .25, c + .25, c + .25],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_multibox_target_basic():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0]]], np.float32)
+    # one gt box matching anchor 0, class 2
+    label = np.array([[[2, 0.01, 0.01, 0.48, 0.52],
+                       [-1, 0, 0, 0, 0]]], np.float32)
+    cls_pred = np.zeros((1, 4, 3), np.float32)
+    loc_t, loc_m, cls_t = _run("MultiBoxTarget", anchors, label, cls_pred)
+    assert loc_t.shape == (1, 12) and cls_t.shape == (1, 3)
+    assert cls_t[0, 0] == 3.0  # class 2 → target 3 (bg=0)
+    assert loc_m[0, :4].sum() == 4.0
+    assert cls_t[0, 1] == 0.0
+
+
+def test_multibox_detection_basic():
+    anchors = np.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], np.float32)
+    cls_prob = np.array([[[0.1, 0.2], [0.9, 0.8]]], np.float32)  # (1,2,A)
+    loc_pred = np.zeros((1, 8), np.float32)
+    out, = _run("MultiBoxDetection", cls_prob, loc_pred, anchors,
+                threshold=0.5)
+    assert out.shape == (1, 2, 6)
+    # both anchors detected as class 0 (background removed from ids)
+    np.testing.assert_allclose(out[0, :, 0], [0.0, 0.0])
+    np.testing.assert_allclose(sorted(out[0, :, 1]), [0.8, 0.9], atol=1e-6)
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.15, 0.0, 0.65, 0.5],   # IoU ≈ 0.54 vs gt
+                         [0.0, 0.6, 0.4, 1.0]]], np.float32)
+    label = np.array([[[1, 0.0, 0.0, 0.5, 0.5]]], np.float32)
+    # cls_pred (N, num_cls, A): anchor 1 is a confident false positive
+    cls_pred = np.array([[[.9, .1, .9, .9], [.1, .9, .1, .1]]], np.float32)
+    _, _, cls_t = _run("MultiBoxTarget", anchors, label, cls_pred,
+                       negative_mining_ratio=1.0,
+                       negative_mining_thresh=0.5, overlap_threshold=0.6)
+    assert cls_t[0, 0] == 2.0        # matched → class 1 + 1
+    assert cls_t[0, 1] == 0.0        # mined hard negative → background
+    assert cls_t[0, 2] == -1.0       # near-positive (IoU ≥ 0.5) → ignored
+    assert cls_t[0, 3] == -1.0       # low-conf negative beyond ratio → ignored
+
+
+def test_proposal_shapes():
+    N, K, H, W = 1, 3, 4, 4
+    rng = np.random.RandomState(3)
+    cls_prob = rng.rand(N, 2 * K, H, W).astype(np.float32)
+    bbox_pred = (rng.rand(N, 4 * K, H, W).astype(np.float32) - 0.5) * 0.1
+    im_info = np.array([[64, 64, 1.0]], np.float32)
+    out, = _run("Proposal", cls_prob, bbox_pred, im_info,
+                feature_stride=16, scales="(8.0,)", ratios="(0.5,1.0,2.0)",
+                rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5, rpn_min_size=0)
+    assert out.shape == (5, 5)
+    assert (out[:, 0] == 0).all()
+    assert (out[:, 1:] >= 0).all() and (out[:, 1:] <= 63).all()
+
+
+def test_count_sketch():
+    data = np.array([[1., 2., 3.]], np.float32)
+    h = np.array([0, 1, 0], np.float32)
+    s = np.array([1, -1, 1], np.float32)
+    out, = _run("count_sketch", data, h, s, out_dim=2)
+    np.testing.assert_allclose(out, [[4., -2.]])
+
+
+def test_fft_ifft_roundtrip():
+    rng = np.random.RandomState(4)
+    x = rng.rand(3, 8).astype(np.float32)
+    f, = _run("fft", x)
+    assert f.shape == (3, 16)
+    back, = _run("ifft", f)
+    np.testing.assert_allclose(back, x * 8, rtol=1e-4, atol=1e-4)
+
+
+# ----------------------------------------------------------------------
+# fused RNN op vs explicit cell unroll
+@pytest.mark.parametrize("mode,G", [("rnn_tanh", 1), ("lstm", 4), ("gru", 3)])
+def test_rnn_op_matches_cells(mode, G):
+    from mxnet_tpu.op.rnn_op import rnn_param_size
+    T, N, I, H, L = 3, 2, 4, 5, 2
+    rng = np.random.RandomState(5)
+    data = rng.normal(0, 1, (T, N, I)).astype(np.float32)
+    psize = rnn_param_size(mode, I, H, L, False)
+    params = rng.normal(0, 0.1, (psize,)).astype(np.float32)
+    state = np.zeros((L, N, H), np.float32)
+    args = [data, params, state]
+    if mode == "lstm":
+        args.append(np.zeros((L, N, H), np.float32))
+    outs = _run("RNN", *args, state_size=H, num_layers=L, mode=mode,
+                state_outputs=True)
+    out = outs[0]
+    assert out.shape == (T, N, H)
+    assert np.isfinite(out).all()
+    # final state output row equals last timestep output of top layer
+    np.testing.assert_allclose(outs[1][-1], out[-1], rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_op_bidirectional():
+    from mxnet_tpu.op.rnn_op import rnn_param_size
+    T, N, I, H = 4, 2, 3, 5
+    rng = np.random.RandomState(6)
+    data = rng.normal(0, 1, (T, N, I)).astype(np.float32)
+    psize = rnn_param_size("gru", I, H, 1, True)
+    params = rng.normal(0, 0.1, (psize,)).astype(np.float32)
+    state = np.zeros((2, N, H), np.float32)
+    out, hN = _run("RNN", data, params, state, state_size=H, num_layers=1,
+                   mode="gru", bidirectional=True, state_outputs=True)
+    assert out.shape == (T, N, 2 * H)
+    assert hN.shape == (2, N, H)
+    # forward half's last step == forward final state
+    np.testing.assert_allclose(out[-1, :, :H], hN[0], rtol=1e-5, atol=1e-5)
+    # backward half's first step == backward final state
+    np.testing.assert_allclose(out[0, :, H:], hN[1], rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_symbol_grad():
+    """RNN op is differentiable end-to-end through the executor."""
+    from mxnet_tpu.op.rnn_op import rnn_param_size
+    T, N, I, H = 3, 2, 4, 4
+    data = mx.sym.Variable("data")
+    par = mx.sym.Variable("params")
+    st = mx.sym.Variable("state")
+    out = mx.sym.RNN(data=data, parameters=par, state=st, state_size=H,
+                     num_layers=1, mode="rnn_tanh", name="rnn")
+    loss = mx.sym.MakeLoss(mx.sym.sum(out))
+    rng = np.random.RandomState(7)
+    psize = rnn_param_size("rnn_tanh", I, H, 1, False)
+    ex = loss.simple_bind(mx.cpu(), data=(T, N, I), params=(psize,),
+                          state=(1, N, H))
+    ex.arg_dict["data"][:] = rng.normal(0, 1, (T, N, I))
+    ex.arg_dict["params"][:] = rng.normal(0, 0.1, (psize,))
+    ex.arg_dict["state"][:] = 0
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["params"].asnumpy()
+    assert np.abs(g).sum() > 0
