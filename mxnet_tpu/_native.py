@@ -1,0 +1,88 @@
+"""Loader for the native runtime library (``native/mxtpu_runtime.cc``).
+
+One shared object carries the dependency engine and RecordIO codec; this
+module owns the ctypes signatures.  ``lib()`` returns None when the
+library is missing and cannot be built (callers fall back to pure
+python), so the framework degrades gracefully on hosts without g++.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+FN_T = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+
+
+def _lib_path():
+    return os.path.join(os.path.dirname(__file__), "lib",
+                        "libmxtpu_runtime.so")
+
+
+def _declare(lib):
+    c = ctypes
+    lib.MXTEngineCreate.restype = c.c_void_p
+    lib.MXTEngineCreate.argtypes = [c.c_int, c.c_int]
+    lib.MXTEngineNewVar.restype = c.c_void_p
+    lib.MXTEngineNewVar.argtypes = [c.c_void_p]
+    lib.MXTEnginePush.argtypes = [
+        c.c_void_p, FN_T, c.c_void_p,
+        c.POINTER(c.c_void_p), c.c_int,
+        c.POINTER(c.c_void_p), c.c_int, c.c_int]
+    lib.MXTEngineWaitAll.argtypes = [c.c_void_p]
+    lib.MXTEngineWaitForVar.argtypes = [c.c_void_p, c.c_void_p]
+    lib.MXTEngineVarVersion.restype = c.c_ulonglong
+    lib.MXTEngineVarVersion.argtypes = [c.c_void_p, c.c_void_p]
+    lib.MXTEnginePending.restype = c.c_long
+    lib.MXTEnginePending.argtypes = [c.c_void_p]
+    lib.MXTEngineFree.argtypes = [c.c_void_p]
+
+    lib.MXTRecordWriterCreate.restype = c.c_void_p
+    lib.MXTRecordWriterCreate.argtypes = [c.c_char_p]
+    lib.MXTRecordWriterFree.argtypes = [c.c_void_p]
+    lib.MXTRecordWriterWrite.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
+    lib.MXTRecordWriterTell.restype = c.c_long
+    lib.MXTRecordWriterTell.argtypes = [c.c_void_p]
+    lib.MXTRecordWriterFlush.argtypes = [c.c_void_p]
+    lib.MXTRecordReaderCreate.restype = c.c_void_p
+    lib.MXTRecordReaderCreate.argtypes = [c.c_char_p]
+    lib.MXTRecordReaderFree.argtypes = [c.c_void_p]
+    lib.MXTRecordReaderNext.restype = c.c_int
+    lib.MXTRecordReaderNext.argtypes = [
+        c.c_void_p, c.POINTER(c.c_char_p), c.POINTER(c.c_size_t)]
+    lib.MXTRecordReaderTell.restype = c.c_long
+    lib.MXTRecordReaderTell.argtypes = [c.c_void_p]
+    lib.MXTRecordReaderSeek.argtypes = [c.c_void_p, c.c_long]
+    return lib
+
+
+def lib():
+    """The loaded native library, or None if unavailable."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        path = _lib_path()
+        if not os.path.exists(path):
+            src_dir = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "native")
+            if os.path.exists(os.path.join(src_dir, "Makefile")):
+                try:
+                    subprocess.run(["make", "-C", src_dir], check=True,
+                                   capture_output=True)
+                except Exception:
+                    pass
+        if os.path.exists(path):
+            try:
+                _LIB = _declare(ctypes.CDLL(path))
+            except OSError:
+                _LIB = None
+        _TRIED = True
+        return _LIB

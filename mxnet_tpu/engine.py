@@ -1,0 +1,141 @@
+"""Dependency engine: ctypes binding over the native C++ scheduler
+(``native/mxtpu_runtime.cc``).
+
+This is the TPU build's analog of the reference engine API
+(``include/mxnet/engine.h:75-250``): ops declare const(read) and
+mutable(write) variables; the engine runs an op once every dependency is
+clear, enforcing RAW/WAR/WAW order per variable.  On TPU, *device* compute
+is ordered inside XLA programs already, so this engine schedules host-side
+work: pipeline stages, checkpoint writes, metric fan-out — the things the
+reference pushed as engine ops around the kernels.
+
+Two modes, selected like the reference's ``MXNET_ENGINE_TYPE``
+(``src/engine/engine.cc:13-39``):
+
+* ``ThreadedEnginePerDevice`` (default) — native worker pool.
+* ``NaiveEngine`` — synchronous, for bisecting scheduling bugs.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Optional, Sequence
+
+__all__ = ["Engine", "Var", "get", "set_engine_type"]
+
+from ._native import FN_T as _FN_T, lib as _lib
+
+
+class Var:
+    """Engine variable handle (``Engine::NewVariable``)."""
+
+    __slots__ = ("handle", "_engine")
+
+    def __init__(self, handle, engine):
+        self.handle = handle
+        self._engine = engine
+
+    @property
+    def version(self):
+        """Completed-write count (used by tests to check WAW ordering)."""
+        return _lib().MXTEngineVarVersion(self._engine._handle, self.handle)
+
+
+class Engine:
+    """Native dependency scheduler.
+
+    ``push(fn, const_vars, mutable_vars)`` runs ``fn()`` when all reads
+    and writes it depends on have cleared.  Python callables are invoked
+    from native worker threads (ctypes re-acquires the GIL), so CPU-bound
+    python stages should release the GIL (numpy/io do).
+    """
+
+    def __init__(self, num_threads: Optional[int] = None,
+                 engine_type: Optional[str] = None):
+        lib = _lib()
+        if lib is None:
+            raise RuntimeError(
+                "native runtime missing; run `make -C native`")
+        engine_type = engine_type or os.environ.get(
+            "MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        naive = 1 if engine_type == "NaiveEngine" else 0
+        if num_threads is None:
+            num_threads = int(os.environ.get("MXNET_CPU_WORKER_NTHREADS",
+                                             os.cpu_count() or 4))
+        self._handle = lib.MXTEngineCreate(num_threads, naive)
+        # ONE persistent CFUNCTYPE dispatcher for the engine's lifetime;
+        # per-op python callables live in _fns keyed by the void* arg.
+        # (Freeing a per-op CFUNCTYPE from inside its own invocation would
+        # free the libffi closure still on the C stack.)
+        self._fns = {}
+        self._ka_lock = threading.Lock()
+        self._seq = 0
+
+        def _dispatch(argp):
+            with self._ka_lock:
+                fn = self._fns.pop(argp, None)
+            if fn is not None:
+                fn()
+
+        self._dispatcher = _FN_T(_dispatch)
+        self.engine_type = "NaiveEngine" if naive else engine_type
+
+    def new_variable(self) -> Var:
+        return Var(_lib().MXTEngineNewVar(self._handle), self)
+
+    def push(self, fn, const_vars: Sequence[Var] = (),
+             mutable_vars: Sequence[Var] = (), priority: int = 0):
+        with self._ka_lock:
+            self._seq += 1
+            seq = self._seq
+            self._fns[seq] = fn
+        nc, nm = len(const_vars), len(mutable_vars)
+        carr = (ctypes.c_void_p * max(nc, 1))(
+            *[v.handle for v in const_vars])
+        marr = (ctypes.c_void_p * max(nm, 1))(
+            *[v.handle for v in mutable_vars])
+        _lib().MXTEnginePush(self._handle, self._dispatcher,
+                             ctypes.c_void_p(seq), carr, nc, marr, nm,
+                             priority)
+
+    def wait_all(self):
+        _lib().MXTEngineWaitAll(self._handle)
+
+    def wait_for_var(self, var: Var):
+        _lib().MXTEngineWaitForVar(self._handle, var.handle)
+
+    @property
+    def num_pending(self):
+        return _lib().MXTEnginePending(self._handle)
+
+    def __del__(self):
+        try:
+            lib = _lib()
+            if getattr(self, "_handle", None) and lib is not None:
+                lib.MXTEngineFree(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+_DEFAULT = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def get() -> Engine:
+    """Process-global engine (``Engine::Get()``)."""
+    global _DEFAULT
+    if _DEFAULT is None:
+        with _DEFAULT_LOCK:
+            if _DEFAULT is None:
+                _DEFAULT = Engine()
+    return _DEFAULT
+
+
+def set_engine_type(engine_type: str):
+    """Swap the global engine (must be called before first use)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = Engine(engine_type=engine_type)
+    return _DEFAULT

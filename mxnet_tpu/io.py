@@ -672,7 +672,7 @@ class ImageRecordIter(DataIter):
             self._producer = None
 
     def _read_record(self, offset):
-        self._record.fio.seek(offset)
+        self._record.seek_to(offset)
         return self._record.read()
 
     def _decode_one(self, raw):
@@ -717,6 +717,13 @@ class ImageRecordIter(DataIter):
         return chw * self.scale
 
     def _produce(self):
+        try:
+            self._produce_impl()
+        except BaseException as e:  # surfaced in next(); never deadlock
+            self._queue.put(e)
+            self._queue.put(None)  # later next() calls see end-of-epoch
+
+    def _produce_impl(self):
         bs = self.batch_size
         n = len(self._order)
         i = 0
@@ -731,11 +738,15 @@ class ImageRecordIter(DataIter):
             labels = np.stack([l for _, l in decoded])
             if self.label_width == 1:
                 labels = labels.reshape(bs)
-            try:
-                self._queue.put((data, labels, pad, idxs.copy()), timeout=60)
-            except queue.Full:
-                if self._stop.is_set():
-                    return
+            item = (data, labels, pad, idxs.copy())
+            while not self._stop.is_set():  # never drop a decoded batch
+                try:
+                    self._queue.put(item, timeout=0.5)
+                    break
+                except queue.Full:
+                    continue
+            if self._stop.is_set():
+                return
             i += bs
         self._queue.put(None)
 
@@ -744,6 +755,8 @@ class ImageRecordIter(DataIter):
         if item is None:
             self._epoch_done = True
             raise StopIteration
+        if isinstance(item, BaseException):
+            raise item
         data, labels, pad, idxs = item
         return DataBatch(data=[array(data)], label=[array(labels)],
                          pad=pad, index=idxs)

@@ -45,12 +45,25 @@ class MXRecordIO(object):
         self.open()
 
     def open(self):
+        from ._native import lib as _native_lib
+        self._nlib = _native_lib()
+        self._nh = None
         if self.flag == "w":
-            self.fio = open(self.uri, "wb")
             self.writable = True
+            if self._nlib is not None:
+                self._nh = self._nlib.MXTRecordWriterCreate(
+                    self.uri.encode())
+            if self._nh is None:
+                self._nlib = None
+                self.fio = open(self.uri, "wb")
         elif self.flag == "r":
-            self.fio = open(self.uri, "rb")
             self.writable = False
+            if self._nlib is not None:
+                self._nh = self._nlib.MXTRecordReaderCreate(
+                    self.uri.encode())
+            if self._nh is None:
+                self._nlib = None
+                self.fio = open(self.uri, "rb")
         else:
             raise ValueError("Invalid flag %s" % self.flag)
         self.is_open = True
@@ -61,7 +74,14 @@ class MXRecordIO(object):
     def close(self):
         if not self.is_open:
             return
-        self.fio.close()
+        if self._nh is not None:
+            if self.writable:
+                self._nlib.MXTRecordWriterFree(self._nh)
+            else:
+                self._nlib.MXTRecordReaderFree(self._nh)
+            self._nh = None
+        else:
+            self.fio.close()
         self.is_open = False
 
     def reset(self):
@@ -69,11 +89,28 @@ class MXRecordIO(object):
         self.open()
 
     def tell(self):
+        if self._nh is not None:
+            if self.writable:
+                return self._nlib.MXTRecordWriterTell(self._nh)
+            return self._nlib.MXTRecordReaderTell(self._nh)
         return self.fio.tell()
+
+    def seek_to(self, pos):
+        """Position the reader at a byte offset (record boundary)."""
+        if self.writable:
+            raise MXNetError("seek on a writer")
+        if self._nh is not None:
+            self._nlib.MXTRecordReaderSeek(self._nh, pos)
+        else:
+            self.fio.seek(pos)
 
     def write(self, buf):
         if not self.writable:
             raise MXNetError("recordio is read-only")
+        if self._nh is not None:
+            data = bytes(buf)
+            self._nlib.MXTRecordWriterWrite(self._nh, data, len(data))
+            return
         data = memoryview(bytes(buf))
         # split payload at aligned magic words (dmlc RecordIOWriter semantics)
         n_words = len(data) >> 2
@@ -104,6 +141,17 @@ class MXRecordIO(object):
     def read(self):
         if self.writable:
             raise MXNetError("recordio is write-only")
+        if self._nh is not None:
+            import ctypes
+            data = ctypes.c_char_p()
+            size = ctypes.c_size_t()
+            rc = self._nlib.MXTRecordReaderNext(
+                self._nh, ctypes.byref(data), ctypes.byref(size))
+            if rc == 0:
+                return None
+            if rc < 0:
+                raise MXNetError("corrupt record stream in %s" % self.uri)
+            return ctypes.string_at(data, size.value)
         chunks = []
         while True:
             head = self.fio.read(8)
@@ -161,9 +209,7 @@ class MXIndexedRecordIO(MXRecordIO):
         super().close()
 
     def seek(self, idx):
-        if self.writable:
-            raise MXNetError("seek on a writer")
-        self.fio.seek(self.idx[idx])
+        self.seek_to(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)
@@ -171,7 +217,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)
-        pos = self.fio.tell()
+        pos = self.tell()
         self.write(buf)
         self.idx[key] = pos
         self.keys.append(key)

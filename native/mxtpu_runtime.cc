@@ -1,0 +1,451 @@
+// mxtpu native runtime: dependency engine + RecordIO.
+//
+// The TPU-native analog of the reference's C++ runtime layer:
+//   * dependency engine  — the async scheduler of src/engine/ (reference
+//     threaded_engine.{h,cc}): ops declare const(read) / mutable(write)
+//     vars; an op runs once every declared dependency is clear, giving
+//     RAW/WAR/WAW ordering per variable.  Device compute on TPU lives
+//     inside XLA programs (which are internally ordered), so this engine
+//     schedules the HOST side: data pipeline stages, checkpoint writes,
+//     callback fan-out — anything the reference pushed as engine ops that
+//     is not a single fused XLA computation.
+//   * RecordIO           — dmlc-core's record format (magic 0xced7230a,
+//     3-bit continuation flag + 29-bit length, pad-to-4), wire-compatible
+//     with the reference's src/io and our python recordio.py.
+//
+// Exposed as a flat C ABI (no pybind11 in the image); python binds with
+// ctypes (mxnet_tpu/engine.py, mxnet_tpu/recordio.py).
+//
+// Build: native/Makefile -> mxnet_tpu/lib/libmxtpu_runtime.so
+
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <memory>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+typedef void (*mxt_fn_t)(void *arg);
+}
+
+namespace mxtpu {
+
+// ---------------------------------------------------------------------
+// Dependency engine
+// ---------------------------------------------------------------------
+struct Opr;
+
+// Per-variable scheduling state.  Grants overlap for reads, exclusivity
+// for writes; FIFO queue preserves program order per var (the reference's
+// VersionedVarBlock chain, threaded_engine.h:44-87).
+struct Var {
+  std::mutex mu;
+  int active_reads = 0;
+  bool active_write = false;
+  std::deque<std::pair<Opr *, bool>> waiting;  // (op, is_write)
+  uint64_t version = 0;  // bumped per completed write (debug/fuzz checks)
+};
+
+struct Opr {
+  mxt_fn_t fn;
+  void *arg;
+  std::vector<Var *> const_vars;
+  std::vector<Var *> mutable_vars;
+  std::atomic<int> wait{0};
+  int priority = 0;
+  uint64_t seq = 0;  // FIFO tiebreak within a priority class
+};
+
+struct OprCmp {
+  bool operator()(const Opr *a, const Opr *b) const {
+    if (a->priority != b->priority) return a->priority < b->priority;
+    return a->seq > b->seq;
+  }
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_threads, bool naive)
+      : naive_(naive), shutdown_(false) {
+    if (!naive_) {
+      if (num_threads <= 0) num_threads = 4;
+      for (int i = 0; i < num_threads; ++i)
+        workers_.emplace_back([this] { WorkerLoop(); });
+    }
+  }
+
+  ~Engine() {
+    WaitAll();
+    {
+      std::lock_guard<std::mutex> lk(qmu_);
+      shutdown_ = true;
+    }
+    qcv_.notify_all();
+    for (auto &t : workers_) t.join();
+    for (Var *v : all_vars_) delete v;
+  }
+
+  Var *NewVar() {
+    Var *v = new Var();
+    std::lock_guard<std::mutex> lk(vmu_);
+    all_vars_.push_back(v);
+    return v;
+  }
+
+  void Push(mxt_fn_t fn, void *arg, Var **cvars, int nc, Var **mvars, int nm,
+            int priority) {
+    Opr *op = new Opr();
+    op->fn = fn;
+    op->arg = arg;
+    op->priority = priority;
+    op->seq = seq_.fetch_add(1);
+    // dedup: a var listed twice (or in both lists) must acquire only once
+    // or the op queues behind its own grant and deadlocks
+    op->mutable_vars.assign(mvars, mvars + nm);
+    std::sort(op->mutable_vars.begin(), op->mutable_vars.end());
+    op->mutable_vars.erase(
+        std::unique(op->mutable_vars.begin(), op->mutable_vars.end()),
+        op->mutable_vars.end());
+    for (int i = 0; i < nc; ++i) {
+      Var *v = cvars[i];
+      bool dup = std::find(op->mutable_vars.begin(), op->mutable_vars.end(),
+                           v) != op->mutable_vars.end() ||
+                 std::find(op->const_vars.begin(), op->const_vars.end(),
+                           v) != op->const_vars.end();
+      if (!dup) op->const_vars.push_back(v);
+    }
+    pending_.fetch_add(1);
+    // Count unsatisfied deps.  Start at 1 so the op cannot fire while we
+    // are still iterating its own dependency list.
+    op->wait.store(1);
+    for (Var *v : op->const_vars) Acquire(op, v, /*write=*/false);
+    for (Var *v : op->mutable_vars) Acquire(op, v, /*write=*/true);
+    if (op->wait.fetch_sub(1) == 1) Schedule(op);
+  }
+
+  void WaitAll() {
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+  void WaitForVar(Var *v) {
+    // Push a no-op READER on the var and wait for it: all writes queued
+    // before us must complete first (engine.h WaitForVar = wait-to-read).
+    // A read grant keeps Var::version an honest completed-write count.
+    struct Sync {
+      std::mutex mu;
+      std::condition_variable cv;
+      bool done = false;
+    } s;
+    auto fnp = +[](void *p) {
+      Sync *sp = static_cast<Sync *>(p);
+      std::lock_guard<std::mutex> lk(sp->mu);
+      sp->done = true;
+      sp->cv.notify_all();
+    };
+    Var *cv[1] = {v};
+    Push(fnp, &s, cv, 1, nullptr, 0, /*priority=*/100);
+    std::unique_lock<std::mutex> lk(s.mu);
+    s.cv.wait(lk, [&s] { return s.done; });
+  }
+
+  uint64_t VarVersion(Var *v) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    return v->version;
+  }
+
+  long Pending() { return pending_.load(); }
+
+ private:
+  void Acquire(Opr *op, Var *v, bool write) {
+    std::lock_guard<std::mutex> lk(v->mu);
+    bool can_run = v->waiting.empty() &&
+                   (write ? (!v->active_write && v->active_reads == 0)
+                          : !v->active_write);
+    if (can_run) {
+      if (write)
+        v->active_write = true;
+      else
+        v->active_reads++;
+    } else {
+      op->wait.fetch_add(1);
+      v->waiting.emplace_back(op, write);
+    }
+  }
+
+  void Release(Opr *op, Var *v, bool write) {
+    std::vector<Opr *> ready;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (write) {
+        v->active_write = false;
+        v->version++;
+      } else {
+        v->active_reads--;
+      }
+      // grant from the head of the queue
+      while (!v->waiting.empty()) {
+        auto [next, w] = v->waiting.front();
+        if (w) {
+          if (v->active_write || v->active_reads > 0) break;
+          v->active_write = true;
+        } else {
+          if (v->active_write) break;
+          v->active_reads++;
+        }
+        v->waiting.pop_front();
+        if (next->wait.fetch_sub(1) == 1) ready.push_back(next);
+        if (w) break;  // a granted write blocks everything behind it
+      }
+    }
+    for (Opr *r : ready) Schedule(r);
+  }
+
+  void Schedule(Opr *op) {
+    if (naive_) {
+      Execute(op);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(qmu_);
+      runq_.push(op);
+    }
+    qcv_.notify_one();
+  }
+
+  void Execute(Opr *op) {
+    op->fn(op->arg);
+    for (Var *v : op->const_vars) Release(op, v, false);
+    for (Var *v : op->mutable_vars) Release(op, v, true);
+    delete op;
+    if (pending_.fetch_sub(1) == 1) {
+      std::lock_guard<std::mutex> lk(done_mu_);
+      done_cv_.notify_all();
+    }
+  }
+
+  void WorkerLoop() {
+    for (;;) {
+      Opr *op = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(qmu_);
+        qcv_.wait(lk, [this] { return shutdown_ || !runq_.empty(); });
+        if (shutdown_ && runq_.empty()) return;
+        op = runq_.top();
+        runq_.pop();
+      }
+      Execute(op);
+    }
+  }
+
+  bool naive_;
+  std::vector<std::thread> workers_;
+  std::priority_queue<Opr *, std::vector<Opr *>, OprCmp> runq_;
+  std::mutex qmu_, vmu_, done_mu_;
+  std::condition_variable qcv_, done_cv_;
+  std::atomic<long> pending_{0};
+  std::atomic<uint64_t> seq_{0};
+  std::vector<Var *> all_vars_;
+  bool shutdown_;
+};
+
+// ---------------------------------------------------------------------
+// RecordIO
+// ---------------------------------------------------------------------
+static const uint32_t kMagic = 0xced7230aU;
+
+class RecordWriter {
+ public:
+  explicit RecordWriter(const char *path) { fp_ = std::fopen(path, "wb"); }
+  ~RecordWriter() {
+    if (fp_) std::fclose(fp_);
+  }
+  bool ok() const { return fp_ != nullptr; }
+
+  void Write(const char *data, size_t len) {
+    // split payload at embedded magic words, link with continuation flags
+    // (dmlc recordio escape scheme; see recordio.py:85-103)
+    // dmlc scans the payload as aligned uint32 words; matches recordio.py
+    std::vector<std::pair<const char *, size_t>> segs;
+    const char *start = data;
+    size_t n_words = len >> 2;
+    for (size_t i = 0; i < n_words; ++i) {
+      uint32_t w;
+      std::memcpy(&w, data + i * 4, 4);
+      if (w == kMagic) {
+        segs.emplace_back(start, data + i * 4 - start);
+        start = data + (i + 1) * 4;
+      }
+    }
+    segs.emplace_back(start, data + len - start);
+    for (size_t i = 0; i < segs.size(); ++i) {
+      uint32_t cflag;
+      if (segs.size() == 1)
+        cflag = 0;
+      else if (i == 0)
+        cflag = 1;
+      else if (i == segs.size() - 1)
+        cflag = 3;
+      else
+        cflag = 2;
+      uint32_t lrec = (cflag << 29) | static_cast<uint32_t>(segs[i].second);
+      std::fwrite(&kMagic, 4, 1, fp_);
+      std::fwrite(&lrec, 4, 1, fp_);
+      if (segs[i].second) std::fwrite(segs[i].first, 1, segs[i].second, fp_);
+      size_t pad = (4 - (segs[i].second % 4)) % 4;
+      static const char zeros[4] = {0, 0, 0, 0};
+      if (pad) std::fwrite(zeros, 1, pad, fp_);
+    }
+  }
+
+  long Tell() { return std::ftell(fp_); }
+  void Flush() { std::fflush(fp_); }
+
+ private:
+  FILE *fp_ = nullptr;
+};
+
+class RecordReader {
+ public:
+  explicit RecordReader(const char *path) { fp_ = std::fopen(path, "rb"); }
+  ~RecordReader() {
+    if (fp_) std::fclose(fp_);
+  }
+  bool ok() const { return fp_ != nullptr; }
+
+  // 1 = record ready, 0 = clean EOF, -1 = corrupt/truncated stream
+  // (the distinction keeps silent dataset truncation impossible; the
+  // python fallback raises on bad magic, so the binding must too)
+  int Next() {
+    buf_.clear();
+    bool more = true;
+    bool first = true;
+    while (more) {
+      uint32_t magic, lrec;
+      size_t got = std::fread(&magic, 1, 4, fp_);
+      if (got == 0 && first) return 0;   // clean EOF at record boundary
+      if (got != 4) return -1;           // truncated header
+      if (magic != kMagic) return -1;    // corrupt stream
+      if (std::fread(&lrec, 1, 4, fp_) != 4) return -1;
+      uint32_t cflag = lrec >> 29;
+      uint32_t len = lrec & ((1U << 29) - 1);
+      size_t off = buf_.size();
+      if (!first) {
+        // rejoin: the escaped magic word goes back between segments
+        buf_.resize(off + 4 + len);
+        std::memcpy(&buf_[off], &kMagic, 4);
+        off += 4;
+      } else {
+        buf_.resize(off + len);
+      }
+      if (len && std::fread(&buf_[off], 1, len, fp_) != len) return -1;
+      size_t pad = (4 - (len % 4)) % 4;
+      if (pad) std::fseek(fp_, static_cast<long>(pad), SEEK_CUR);
+      more = (cflag == 1 || cflag == 2);
+      first = false;
+    }
+    return 1;
+  }
+
+  const char *Data() const { return buf_.data(); }
+  size_t Size() const { return buf_.size(); }
+  long Tell() { return std::ftell(fp_); }
+  void Seek(long pos) { std::fseek(fp_, pos, SEEK_SET); }
+
+ private:
+  FILE *fp_ = nullptr;
+  std::vector<char> buf_;
+};
+
+}  // namespace mxtpu
+
+// ---------------------------------------------------------------------
+// C ABI
+// ---------------------------------------------------------------------
+extern "C" {
+
+void *MXTEngineCreate(int num_threads, int naive) {
+  return new mxtpu::Engine(num_threads, naive != 0);
+}
+void MXTEngineFree(void *h) { delete static_cast<mxtpu::Engine *>(h); }
+void *MXTEngineNewVar(void *h) {
+  return static_cast<mxtpu::Engine *>(h)->NewVar();
+}
+void MXTEnginePush(void *h, mxt_fn_t fn, void *arg, void **cvars, int nc,
+                   void **mvars, int nm, int priority) {
+  static_cast<mxtpu::Engine *>(h)->Push(
+      fn, arg, reinterpret_cast<mxtpu::Var **>(cvars), nc,
+      reinterpret_cast<mxtpu::Var **>(mvars), nm, priority);
+}
+void MXTEngineWaitAll(void *h) { static_cast<mxtpu::Engine *>(h)->WaitAll(); }
+void MXTEngineWaitForVar(void *h, void *v) {
+  static_cast<mxtpu::Engine *>(h)->WaitForVar(static_cast<mxtpu::Var *>(v));
+}
+unsigned long long MXTEngineVarVersion(void *h, void *v) {
+  return static_cast<mxtpu::Engine *>(h)->VarVersion(
+      static_cast<mxtpu::Var *>(v));
+}
+long MXTEnginePending(void *h) {
+  return static_cast<mxtpu::Engine *>(h)->Pending();
+}
+
+void *MXTRecordWriterCreate(const char *path) {
+  auto *w = new mxtpu::RecordWriter(path);
+  if (!w->ok()) {
+    delete w;
+    return nullptr;
+  }
+  return w;
+}
+void MXTRecordWriterFree(void *h) {
+  delete static_cast<mxtpu::RecordWriter *>(h);
+}
+void MXTRecordWriterWrite(void *h, const char *data, size_t len) {
+  static_cast<mxtpu::RecordWriter *>(h)->Write(data, len);
+}
+long MXTRecordWriterTell(void *h) {
+  return static_cast<mxtpu::RecordWriter *>(h)->Tell();
+}
+void MXTRecordWriterFlush(void *h) {
+  static_cast<mxtpu::RecordWriter *>(h)->Flush();
+}
+
+void *MXTRecordReaderCreate(const char *path) {
+  auto *r = new mxtpu::RecordReader(path);
+  if (!r->ok()) {
+    delete r;
+    return nullptr;
+  }
+  return r;
+}
+void MXTRecordReaderFree(void *h) {
+  delete static_cast<mxtpu::RecordReader *>(h);
+}
+// returns 1 and sets (*data,*size) on success, 0 at EOF, -1 on corruption
+int MXTRecordReaderNext(void *h, const char **data, size_t *size) {
+  auto *r = static_cast<mxtpu::RecordReader *>(h);
+  int rc = r->Next();
+  if (rc != 1) return rc;
+  *data = r->Data();
+  *size = r->Size();
+  return 1;
+}
+long MXTRecordReaderTell(void *h) {
+  return static_cast<mxtpu::RecordReader *>(h)->Tell();
+}
+void MXTRecordReaderSeek(void *h, long pos) {
+  static_cast<mxtpu::RecordReader *>(h)->Seek(pos);
+}
+
+}  // extern "C"
