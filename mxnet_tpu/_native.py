@@ -45,6 +45,7 @@ def _declare(lib):
     lib.MXTRecordWriterCreate.restype = c.c_void_p
     lib.MXTRecordWriterCreate.argtypes = [c.c_char_p]
     lib.MXTRecordWriterFree.argtypes = [c.c_void_p]
+    lib.MXTRecordWriterWrite.restype = c.c_int
     lib.MXTRecordWriterWrite.argtypes = [c.c_void_p, c.c_char_p, c.c_size_t]
     lib.MXTRecordWriterTell.restype = c.c_long
     lib.MXTRecordWriterTell.argtypes = [c.c_void_p]

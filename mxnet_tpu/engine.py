@@ -39,6 +39,8 @@ class Var:
     @property
     def version(self):
         """Completed-write count (used by tests to check WAW ordering)."""
+        if self._engine._handle is None:
+            raise RuntimeError("engine owning this Var has been freed")
         return _lib().MXTEngineVarVersion(self._engine._handle, self.handle)
 
 
@@ -71,12 +73,22 @@ class Engine:
         self._fns = {}
         self._ka_lock = threading.Lock()
         self._seq = 0
+        self._exc = None  # first op failure; re-raised at the next sync point
 
         def _dispatch(argp):
             with self._ka_lock:
                 fn = self._fns.pop(argp, None)
             if fn is not None:
-                fn()
+                try:
+                    fn()
+                except BaseException as e:  # noqa: BLE001
+                    # ops run on native worker threads; surface the first
+                    # failure at wait_all/wait_for_var like the reference
+                    # engine's on_complete error path rather than losing it
+                    # to the unraisable hook
+                    with self._ka_lock:
+                        if self._exc is None:
+                            self._exc = e
 
         self._dispatcher = _FN_T(_dispatch)
         self.engine_type = "NaiveEngine" if naive else engine_type
@@ -101,9 +113,17 @@ class Engine:
 
     def wait_all(self):
         _lib().MXTEngineWaitAll(self._handle)
+        self._raise_pending()
 
     def wait_for_var(self, var: Var):
         _lib().MXTEngineWaitForVar(self._handle, var.handle)
+        self._raise_pending()
+
+    def _raise_pending(self):
+        with self._ka_lock:
+            exc, self._exc = self._exc, None
+        if exc is not None:
+            raise exc
 
     @property
     def num_pending(self):

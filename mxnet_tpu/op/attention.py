@@ -1,0 +1,33 @@
+"""Attention ops.
+
+The reference predates attention entirely (SURVEY.md §5 long-context:
+bucketing + truncated BPTT were its only sequence-scaling tools), so these
+are greenfield capability ops.  ``_contrib_DotProductAttention`` is exact
+multi-head attention over ``[batch, time, heads, dim]`` inputs; on TPU it
+runs the Pallas flash kernel (O(T*block) memory, MXU-blocked); elsewhere a
+jnp oracle with identical semantics.  Sequence parallelism over a mesh is
+``mx.parallel.ring_attention`` — same math, K/V rotated over ICI.
+"""
+from __future__ import annotations
+
+import jax
+
+from .registry import Param, register
+
+
+@register("_contrib_DotProductAttention",
+          input_names=("query", "key", "value"),
+          params_spec=(Param("causal", bool, False),
+                       Param("scale", float, -1.0),
+                       Param("flash", bool, True),
+                       Param("block_q", int, 128),
+                       Param("block_k", int, 128)),
+          hint="dotproductattention")
+def _dot_product_attention(p, c, q, k, v):
+    scale = None if p["scale"] <= 0 else p["scale"]
+    if p["flash"]:
+        from .pallas import flash_attention
+        return flash_attention(q, k, v, causal=p["causal"], scale=scale,
+                               block_q=p["block_q"], block_k=p["block_k"])
+    from ..parallel.ring_attention import attention_reference
+    return attention_reference(q, k, v, causal=p["causal"], scale=scale)

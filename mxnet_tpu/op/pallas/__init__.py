@@ -1,0 +1,11 @@
+"""Pallas TPU kernels for hot ops.
+
+The reference's analog of this directory is its hand-written CUDA kernels
+(``src/operator/*.cu``) and NVRTC runtime compilation (``src/common/mxrtc.cc``).
+On TPU the compiler (XLA) covers almost everything; Pallas is reserved for
+ops where manual VMEM blocking beats XLA's schedule — attention being the
+canonical case (O(T^2) memory -> O(T*block)).
+"""
+from .flash_attention import flash_attention, flash_attention_reference
+
+__all__ = ["flash_attention", "flash_attention_reference"]

@@ -1,0 +1,252 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Forward is a Pallas kernel: one grid step per (batch*head, q-block); K/V
+live in VMEM and the kernel walks K in ``block_k`` tiles keeping the online
+softmax state (running max ``m``, denominator ``l``, accumulator ``o``) in
+registers/VMEM, so HBM traffic is O(T) per q-block instead of the O(T^2)
+score matrix.  The MXU sees two big matmuls per tile (QK^T and PV) in
+float32 accumulation.
+
+Backward is the standard recomputation form (no score matrix saved — only
+the per-row logsumexp): a ``lax.scan`` over K blocks recomputes P from
+(Q, K, lse) and accumulates dQ/dK/dV, keeping memory O(T * block_k).  XLA
+fuses each scan body into a handful of MXU calls, so a hand-written Pallas
+backward buys little on TPU; the forward kernel is where manual blocking
+wins.
+
+The 2017-era reference has no attention op at all (SURVEY.md §5
+long-context); this is greenfield capability required for parity with
+modern workloads.  Layout convention matches ``parallel.ring_attention``:
+``[batch, time, heads, dim]``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "flash_attention_reference"]
+
+_NEG_INF = float("-inf")
+
+
+_LANES = 128  # VPU lane width; per-row softmax state is lane-replicated
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *,
+                block_k, causal, scale, t_kv_real, block_q):
+    # Grid is (bh, n_qb, n_kb) with the K dimension innermost: K/V stream
+    # through VMEM one [block_k, d] tile per step (never the full sequence),
+    # while the online-softmax state (acc/m/l) carries in VMEM scratch.
+    q_blk_idx = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # with causal masking, tiles entirely above the diagonal contribute
+    # nothing — skip their matmuls (the scheduler still runs init/finalize)
+    first_q = q_blk_idx * block_q
+    live = (kb * block_k <= first_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _update():
+        qb = q_ref[0].astype(jnp.float32) * scale
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [block_q, block_k]
+        q_pos = first_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < t_kv_real
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = m_ref[:, 0:1]  # [block_q, 1], lane-replicated
+        l = l_ref[:, 0:1]
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_blk)
+        m_safe = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_safe)
+        p = jnp.where(mask, p, 0.0)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, vblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        m = m_ref[:, 0]
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse = jnp.where(jnp.isneginf(m), _NEG_INF, m + jnp.log(l_safe))
+        # lse block is the full [n_qb, block_q] plane for this bh (TPU
+        # tiling needs trailing block dims to match the array); each
+        # (j, last-k) step fills its own row.
+        lse_ref[0, q_blk_idx, :] = lse
+
+
+def _pad_time(x, block):
+    t = x.shape[1]
+    pad = (-t) % block
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+    return x
+
+
+def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
+    """q/k/v: [bh, t, d] -> (o [bh, t, d], lse [bh, t_q_pad])."""
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    qp = _pad_time(q, block_q)
+    kp = _pad_time(k, block_k)
+    vp = _pad_time(v, block_k)
+    t_qp, t_kvp = qp.shape[1], kp.shape[1]
+    n_qb = t_qp // block_q
+    n_kb = t_kvp // block_k
+    grid = (bh, n_qb, n_kb)
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+        t_kv_real=t_kv, block_q=block_q)
+    kwargs = {}
+    if not interpret:
+        from jax.experimental.pallas import tpu as pltpu
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"))
+    from jax.experimental.pallas import tpu as pltpu
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, block_k, d), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, n_qb, block_q), lambda i, j, kb: (i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_qp, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n_qb, block_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),       # acc
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running denom
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(qp, kp, vp)
+    return o[:, :t_q], lse.reshape(bh, t_qp)
+
+
+def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_k):
+    """Blockwise recompute backward; all arrays [bh, t, d], lse [bh, t_qp]."""
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    f32 = jnp.float32
+    qs = q.astype(f32) * scale
+    do32 = do.astype(f32)
+    o32 = o.astype(f32)
+    lse = lse[:, :t_q]
+    delta = jnp.sum(do32 * o32, axis=-1)  # [bh, t_q]
+
+    kp = _pad_time(k.astype(f32), block_k)
+    vp = _pad_time(v.astype(f32), block_k)
+    t_kvp = kp.shape[1]
+    n_kb = t_kvp // block_k
+    kb_arr = kp.reshape(bh, n_kb, block_k, d).transpose(1, 0, 2, 3)
+    vb_arr = vp.reshape(bh, n_kb, block_k, d).transpose(1, 0, 2, 3)
+
+    q_pos = jnp.arange(t_q)
+
+    def body(dq, xs):
+        kb_idx, kblk, vblk = xs
+        s = jnp.einsum("btd,bkd->btk", qs, kblk)
+        k_pos = kb_idx * block_k + jnp.arange(block_k)
+        mask = k_pos[None, :] < t_kv
+        if causal:
+            mask = jnp.logical_and(mask, q_pos[:, None] >= k_pos[None, :])
+        s = jnp.where(mask[None], s, _NEG_INF)
+        # exp(-inf - lse) -> 0 even when lse == -inf thanks to the where
+        p = jnp.where(mask[None], jnp.exp(s - lse[..., None]), 0.0)
+        dv_blk = jnp.einsum("btk,btd->bkd", p, do32)
+        dp = jnp.einsum("btd,bkd->btk", do32, vblk)
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum("btk,bkd->btd", ds, kblk) * scale
+        dk_blk = jnp.einsum("btk,btd->bkd", ds, qs)
+        return dq, (dk_blk, dv_blk)
+
+    dq0 = jnp.zeros((bh, t_q, d), f32)
+    dq, (dk_b, dv_b) = jax.lax.scan(
+        body, dq0, (jnp.arange(n_kb), kb_arr, vb_arr))
+    dk = dk_b.transpose(1, 0, 2, 3).reshape(bh, t_kvp, d)[:, :t_kv]
+    dv = dv_b.transpose(1, 0, 2, 3).reshape(bh, t_kvp, d)[:, :t_kv]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd_impl(q, k, v, o, lse, do, causal, scale, block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Memory-efficient exact attention.
+
+    Args: ``q`` [b, t_q, h, d], ``k``/``v`` [b, t_kv, h, d] (the
+    ``ring_attention`` layout).  Returns [b, t_q, h, d] in ``q.dtype``.
+
+    ``interpret=None`` auto-selects: compiled Pallas on TPU, interpreter
+    elsewhere (bit-accurate, used by the CPU test mesh).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, t_q, h, d = q.shape
+    t_kv = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    block_q = min(block_q, max(t_q, 1))
+    block_k = min(block_k, max(t_kv, 1))
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+
+    o = _flash(fold(q), fold(k), fold(v), causal, float(scale),
+               block_q, block_k, interpret)
+    return o.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+
+
+def flash_attention_reference(q, k, v, causal=False, scale=None):
+    """O(T^2) jnp oracle (same layout), for tests and tiny shapes."""
+    from ...parallel.ring_attention import attention_reference
+    return attention_reference(q, k, v, causal=causal, scale=scale)

@@ -107,11 +107,18 @@ class MXRecordIO(object):
     def write(self, buf):
         if not self.writable:
             raise MXNetError("recordio is read-only")
+        raw = buf if isinstance(buf, bytes) else bytes(buf)
+        # segment length is a 29-bit field; a magic-free payload this large
+        # would overflow into the cflag bits (dmlc's writer CHECKs the same)
+        if len(raw) >= (1 << 29):
+            raise MXNetError(
+                "record of %d bytes exceeds the 29-bit segment limit"
+                % len(raw))
         if self._nh is not None:
-            data = bytes(buf)
-            self._nlib.MXTRecordWriterWrite(self._nh, data, len(data))
+            if not self._nlib.MXTRecordWriterWrite(self._nh, raw, len(raw)):
+                raise MXNetError("native RecordWriter write failed")
             return
-        data = memoryview(bytes(buf))
+        data = memoryview(raw)
         # split payload at aligned magic words (dmlc RecordIOWriter semantics)
         n_words = len(data) >> 2
         words = np.frombuffer(data[:n_words * 4], dtype="<u4")

@@ -273,10 +273,13 @@ class RecordWriter {
   }
   bool ok() const { return fp_ != nullptr; }
 
-  void Write(const char *data, size_t len) {
+  bool Write(const char *data, size_t len) {
     // split payload at embedded magic words, link with continuation flags
     // (dmlc recordio escape scheme; see recordio.py:85-103)
     // dmlc scans the payload as aligned uint32 words; matches recordio.py
+    // segment length is a 29-bit field; a longer magic-free payload would
+    // overflow into the cflag bits and corrupt the stream
+    if (len >= (1UL << 29)) return false;
     std::vector<std::pair<const char *, size_t>> segs;
     const char *start = data;
     size_t n_words = len >> 2;
@@ -307,6 +310,7 @@ class RecordWriter {
       static const char zeros[4] = {0, 0, 0, 0};
       if (pad) std::fwrite(zeros, 1, pad, fp_);
     }
+    return true;
   }
 
   long Tell() { return std::ftell(fp_); }
@@ -411,8 +415,8 @@ void *MXTRecordWriterCreate(const char *path) {
 void MXTRecordWriterFree(void *h) {
   delete static_cast<mxtpu::RecordWriter *>(h);
 }
-void MXTRecordWriterWrite(void *h, const char *data, size_t len) {
-  static_cast<mxtpu::RecordWriter *>(h)->Write(data, len);
+int MXTRecordWriterWrite(void *h, const char *data, size_t len) {
+  return static_cast<mxtpu::RecordWriter *>(h)->Write(data, len) ? 1 : 0;
 }
 long MXTRecordWriterTell(void *h) {
   return static_cast<mxtpu::RecordWriter *>(h)->Tell();

@@ -1,0 +1,102 @@
+"""Flash attention (Pallas kernel, interpret mode on the CPU test mesh)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.op.pallas import flash_attention, flash_attention_reference
+
+
+def _qkv(rng, b, tq, tkv, h, d):
+    q = jnp.asarray(rng.normal(0, 1, (b, tq, h, d)).astype(np.float32))
+    k = jnp.asarray(rng.normal(0, 1, (b, tkv, h, d)).astype(np.float32))
+    v = jnp.asarray(rng.normal(0, 1, (b, tkv, h, d)).astype(np.float32))
+    return q, k, v
+
+
+@pytest.mark.parametrize("tq,tkv,causal", [
+    (64, 64, False), (64, 64, True),
+    (37, 53, False),          # ragged (padding path)
+    (100, 100, True),         # ragged + causal
+    (32, 128, True),          # cross-attention shapes
+])
+def test_flash_forward_matches_reference(tq, tkv, causal):
+    rng = np.random.RandomState(0)
+    q, k, v = _qkv(rng, 2, tq, tkv, 3, 16)
+    out = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+    ref = flash_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_gradients_match_reference(causal):
+    rng = np.random.RandomState(1)
+    q, k, v = _qkv(rng, 2, 48, 48, 2, 8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(
+            q, k, v, causal=causal, block_q=16, block_k=16)))
+
+    def loss_ref(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention_reference(
+            q, k, v, causal=causal)))
+
+    g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16_io():
+    rng = np.random.RandomState(2)
+    q, k, v = _qkv(rng, 1, 64, 64, 2, 16)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out = flash_attention(q, k, v, block_q=32, block_k=32)
+    assert out.dtype == jnp.bfloat16
+    ref = flash_attention_reference(q.astype(jnp.float32),
+                                    k.astype(jnp.float32),
+                                    v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref), rtol=0.05, atol=0.05)
+
+
+def test_dot_product_attention_op_nd_and_sym():
+    rng = np.random.RandomState(3)
+    qn, kn, vn = (rng.normal(0, 1, (2, 40, 2, 8)).astype(np.float32)
+                  for _ in range(3))
+    # imperative
+    out = mx.nd._contrib_DotProductAttention(
+        mx.nd.array(qn), mx.nd.array(kn), mx.nd.array(vn),
+        causal=True, block_q=16, block_k=16)
+    ref = flash_attention_reference(jnp.asarray(qn), jnp.asarray(kn),
+                                    jnp.asarray(vn), causal=True)
+    np.testing.assert_allclose(out.asnumpy(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # symbolic
+    q = mx.sym.Variable("q")
+    k = mx.sym.Variable("k")
+    v = mx.sym.Variable("v")
+    sym = mx.sym._contrib_DotProductAttention(q, k, v, causal=True,
+                                              block_q=16, block_k=16)
+    ex = sym.bind(mx.tpu(), {"q": mx.nd.array(qn), "k": mx.nd.array(kn),
+                             "v": mx.nd.array(vn)})
+    (o,) = ex.forward()
+    np.testing.assert_allclose(o.asnumpy(), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_matches_ring_attention():
+    """Single-device flash and multi-device ring agree on the same input."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention_sharded
+    rng = np.random.RandomState(4)
+    q, k, v = _qkv(rng, 2, 64, 64, 2, 8)
+    mesh = make_mesh({"seq": 4})
+    ring = ring_attention_sharded(q, k, v, mesh, axis="seq", causal=True)
+    flash = flash_attention(q, k, v, causal=True, block_q=16, block_k=16)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(flash),
+                               rtol=1e-5, atol=1e-5)
