@@ -62,7 +62,11 @@ from .optimizer import Optimizer
 from . import lr_scheduler
 from . import metric
 from . import callback
+from . import storage
+from . import resource
 from . import io
+from . import image
+from . import image as img
 from . import recordio
 from . import kvstore
 from . import kvstore as kv

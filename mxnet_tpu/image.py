@@ -1,0 +1,529 @@
+"""``mx.image``: python-side image decode / resize / augment pipeline.
+
+API parity with the reference's ``python/mxnet/image.py`` (535 LoC, v0.9.5):
+``imdecode`` (ref :26), ``scale_down`` (:45), ``resize_short`` (:56),
+``fixed_crop`` (:66), ``random_crop`` (:74), ``center_crop`` (:86),
+``color_normalize`` (:98), ``random_size_crop`` (:106), the closure-style
+augmenter constructors (``ResizeAug`` :130 … ``CastAug`` :261,
+``CreateAugmenter`` :272), and ``ImageIter`` (:321).
+
+The reference decodes via OpenCV (``cv2.imdecode``) and stores images as
+**BGR** HWC uint8 NDArrays.  The TPU build decodes on the host with PIL and
+keeps the same HWC layout; ``to_rgb`` (default True, like the reference's
+``imdecode(..., to_rgb=1)``) yields RGB.  All functions take and return
+:class:`~mxnet_tpu.ndarray.NDArray` so user code ports unchanged; the
+augmentation runs on host numpy (cheap, overlapped with device compute by
+``ImageIter``'s prefetch thread), while ``color_normalize`` on-device is a
+single fused XLA op when given device arrays.
+"""
+import io as _pyio
+import logging
+import os
+import random as _pyrandom
+
+import numpy as np
+
+from . import ndarray as nd
+from . import recordio
+from . import io as _io
+
+__all__ = [
+    "imdecode", "imread", "imresize", "scale_down", "resize_short",
+    "fixed_crop", "random_crop", "center_crop", "color_normalize",
+    "random_size_crop", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+    "RandomSizedCropAug", "CenterCropAug", "RandomOrderAug",
+    "ColorJitterAug", "LightingAug", "ColorNormalizeAug",
+    "HorizontalFlipAug", "CastAug", "CreateAugmenter", "ImageIter",
+]
+
+# PIL interpolation table indexed by the reference's cv2 interp enum
+# (0=NEAREST 1=LINEAR 2=CUBIC 3=AREA 4=LANCZOS).
+def _interp(flag):
+    from PIL import Image
+    return {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+            3: Image.BOX, 4: Image.LANCZOS}.get(int(flag), Image.BICUBIC)
+
+
+def _to_np(src):
+    return src.asnumpy() if isinstance(src, nd.NDArray) else np.asarray(src)
+
+
+def _like(out, src):
+    """Return ``out`` as the same container kind as ``src``: NDArray in →
+    NDArray out (API parity), numpy in → numpy out (keeps the ImageIter hot
+    path host-side — no per-sample device transfers)."""
+    return nd.array(out, dtype=out.dtype) if isinstance(src, nd.NDArray) \
+        else out
+
+
+def _imdecode_np(buf, flag=1, to_rgb=True):
+    from PIL import Image
+    if isinstance(buf, nd.NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = Image.open(_pyio.BytesIO(bytes(buf)))
+    if int(flag) == 0:
+        return np.asarray(img.convert("L"), dtype=np.uint8)[:, :, None]
+    arr = np.asarray(img.convert("RGB"), dtype=np.uint8)
+    return arr if to_rgb else arr[:, :, ::-1]
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode a compressed image buffer to an HWC uint8 NDArray.
+
+    Mirrors ``image.py:26-42`` (cv2.imdecode + BGR→RGB flip).  ``flag=0``
+    decodes grayscale (HW1)."""
+    return nd.array(_imdecode_np(buf, flag, to_rgb), dtype=np.uint8)
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file and decode it (convenience over :func:`imdecode`)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=2):
+    """Resize HWC image to (h, w).  TPU analog of ``mx.nd.imresize``
+    (``src/io/image_io.cc``).  Accepts uint8 or float input (the
+    reference's cv2.resize handles both)."""
+    from PIL import Image
+    arr = _to_np(src)
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    if arr.dtype == np.uint8:
+        pil = Image.fromarray(arr[:, :, 0] if squeeze else arr)
+        out = np.asarray(pil.resize((int(w), int(h)), _interp(interp)))
+    else:
+        # PIL can't build a multi-channel float image; resize channel-wise
+        # through float32 'F' mode planes
+        planes = [np.asarray(
+            Image.fromarray(arr[:, :, c].astype(np.float32), mode="F")
+            .resize((int(w), int(h)), _interp(interp)))
+            for c in range(arr.shape[2] if arr.ndim == 3 else 1)]
+        out = np.stack(planes, axis=2).astype(arr.dtype) \
+            if arr.ndim == 3 else planes[0].astype(arr.dtype)
+        squeeze = False
+    if squeeze:
+        out = out[:, :, None]
+    return _like(out.astype(arr.dtype), src)
+
+
+def scale_down(src_size, size):
+    """Scale ``size`` down to fit in ``src_size``, keeping aspect ratio
+    (``image.py:45-53``)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def resize_short(src, size, interp=2):
+    """Resize so the shorter edge equals ``size`` (``image.py:56-63``)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(arr, new_w, new_h, interp=interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    """Crop ``[y0:y0+h, x0:x0+w]`` then optionally resize
+    (``image.py:66-71``)."""
+    arr = _to_np(src)
+    out = arr[int(y0):int(y0) + int(h), int(x0):int(x0) + int(w)]
+    if size is not None and (w, h) != size:
+        return _like(_to_np(imresize(out, size[0], size[1], interp=interp)),
+                     src)
+    return _like(out, src)
+
+
+def random_crop(src, size, interp=2):
+    """Random crop to ``size`` (scaled down if needed); returns
+    ``(img, (x0, y0, w, h))`` (``image.py:74-83``)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = _pyrandom.randint(0, w - new_w)
+    y0 = _pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    """Center crop to ``size``; returns ``(img, roi)`` (``image.py:86-95``)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    new_w, new_h = scale_down((w, h), size)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    """``(src - mean) / std`` in float32 (``image.py:98-103``); either
+    stat may be None."""
+    arr = _to_np(src).astype(np.float32)
+    if mean is not None:
+        arr -= _to_np(mean)
+    if std is not None:
+        arr /= _to_np(std)
+    return _like(arr, src)
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area+aspect-ratio crop, falling back to :func:`random_crop`
+    (``image.py:106-127``)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    area = w * h
+    for _ in range(10):
+        new_area = _pyrandom.uniform(min_area, 1.0) * area
+        new_ratio = _pyrandom.uniform(*ratio)
+        new_w = int(np.sqrt(new_area * new_ratio))
+        new_h = int(np.sqrt(new_area / new_ratio))
+        if _pyrandom.random() < 0.5:
+            new_w, new_h = new_h, new_w
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return random_crop(src, size, interp)
+
+
+# --- closure-style augmenters (reference ``image.py:130-269``) ---
+
+def ResizeAug(size, interp=2):
+    """Short-edge resize augmenter."""
+    def aug(src):
+        return [resize_short(src, size, interp)]
+    return aug
+
+
+def ForceResizeAug(size, interp=2):
+    """Exact-size resize augmenter (ignores aspect ratio)."""
+    def aug(src):
+        return [imresize(src, size[0], size[1], interp)]
+    return aug
+
+
+def RandomCropAug(size, interp=2):
+    def aug(src):
+        return [random_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomSizedCropAug(size, min_area, ratio, interp=2):
+    def aug(src):
+        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
+    return aug
+
+
+def CenterCropAug(size, interp=2):
+    def aug(src):
+        return [center_crop(src, size, interp)[0]]
+    return aug
+
+
+def RandomOrderAug(ts):
+    """Apply a list of augmenter lists in random order (``image.py:170-181``)."""
+    def aug(src):
+        srcs = [src]
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            srcs = [img for s in srcs for img in t(s)]
+        return srcs
+    return aug
+
+
+def ColorJitterAug(brightness, contrast, saturation):
+    """Random brightness/contrast/saturation jitter (``image.py:184-221``)."""
+    coef = np.array([[[0.299, 0.587, 0.114]]], dtype=np.float32)
+
+    def baug(src):
+        alpha = 1.0 + _pyrandom.uniform(-brightness, brightness)
+        return [_to_np(src).astype(np.float32) * alpha]
+
+    def caug(src):
+        alpha = 1.0 + _pyrandom.uniform(-contrast, contrast)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * coef).sum(axis=2, keepdims=True).mean() * (1.0 - alpha)
+        return [arr * alpha + gray]
+
+    def saug(src):
+        alpha = 1.0 + _pyrandom.uniform(-saturation, saturation)
+        arr = _to_np(src).astype(np.float32)
+        gray = (arr * coef).sum(axis=2, keepdims=True) * (1.0 - alpha)
+        return [arr * alpha + gray]
+
+    ts = []
+    if brightness > 0:
+        ts.append(baug)
+    if contrast > 0:
+        ts.append(caug)
+    if saturation > 0:
+        ts.append(saug)
+    return RandomOrderAug(ts)
+
+
+def LightingAug(alphastd, eigval, eigvec):
+    """PCA-lighting noise (AlexNet-style; ``image.py:224-234``)."""
+    def aug(src):
+        alpha = np.random.normal(0, alphastd, size=(3,))
+        rgb = np.dot(_to_np(eigvec) * alpha, _to_np(eigval))
+        return [(_to_np(src) + rgb).astype(np.float32)]
+    return aug
+
+
+def ColorNormalizeAug(mean, std):
+    mean_np = None if mean is None else _to_np(mean).astype(np.float32)
+    std_np = None if std is None else _to_np(std).astype(np.float32)
+
+    def aug(src):
+        arr = _to_np(src).astype(np.float32)
+        if mean_np is not None:
+            arr = arr - mean_np
+        if std_np is not None:
+            arr = arr / std_np
+        return [arr]
+    return aug
+
+
+def HorizontalFlipAug(p):
+    def aug(src):
+        if _pyrandom.random() < p:
+            return [_to_np(src)[:, ::-1, :]]
+        return [_to_np(src)]
+    return aug
+
+
+def CastAug():
+    def aug(src):
+        return [_to_np(src).astype(np.float32)]
+    return aug
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Assemble the standard training augmenter list (``image.py:272-318``)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = np.array([55.46, 4.794, 1.148])
+        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
+                           [-0.5808, -0.0045, -0.8140],
+                           [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    elif mean is not None:
+        mean = _to_np(mean)
+        assert mean.shape[0] in [1, 3]
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    elif std is not None:
+        std = _to_np(std)
+        assert std.shape[0] in [1, 3]
+    if mean is not None or std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(_io.DataIter):
+    """Python image iterator over a RecordIO file and/or an image list.
+
+    Mirrors ``image.py:321-535``: reads ``.rec`` (via
+    :class:`~mxnet_tpu.recordio.MXIndexedRecordIO`) or a ``.lst`` file +
+    ``path_root`` of raw images, decodes, applies ``aug_list`` (default from
+    :func:`CreateAugmenter`), and yields CHW float32 batches with the
+    standard ``provide_data``/``provide_label`` contract."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(int(batch_size))
+        assert path_imgrec or path_imglist or (isinstance(imglist, list))
+        assert len(data_shape) == 3 and data_shape[0] == 3
+        self.data_shape = tuple(int(x) for x in data_shape)
+        self.label_width = int(label_width)
+        self.data_name = data_name
+        self.label_name = label_name
+
+        self.imgrec = None
+        if path_imgrec:
+            logging.info("ImageIter: loading recordio %s...", path_imgrec)
+            if path_imgidx is None:
+                guess = os.path.splitext(path_imgrec)[0] + ".idx"
+                path_imgidx = guess if os.path.isfile(guess) else None
+            if path_imgidx:
+                self.imgrec = recordio.MXIndexedRecordIO(
+                    path_imgidx, path_imgrec, "r")
+                self.imgidx = list(self.imgrec.keys)
+            else:
+                self.imgrec = recordio.MXRecordIO(path_imgrec, "r")
+                self.imgidx = None
+
+        self.imglist = None
+        if path_imglist:
+            logging.info("ImageIter: loading image list %s...", path_imglist)
+            result = {}
+            with open(path_imglist) as fin:
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array([float(i) for i in line[1:-1]],
+                                     dtype=np.float32)
+                    result[int(line[0])] = (label, line[-1])
+            self.imglist = result
+        elif isinstance(imglist, list):
+            result = {}
+            for index, img in enumerate(imglist):
+                label = np.array(img[0], dtype=np.float32).reshape(-1)
+                result[index] = (label, img[1])
+            self.imglist = result
+        self.path_root = path_root
+
+        if self.imglist is not None:
+            self.seq = list(self.imglist.keys())
+        elif self.imgidx is not None:
+            self.seq = self.imgidx
+        else:
+            self.seq = None
+
+        if (shuffle or num_parts > 1) and self.seq is None:
+            raise ValueError("shuffle/num_parts>1 need random access: "
+                             "provide path_imgidx or an image list")
+        if num_parts > 1:
+            n = len(self.seq) // int(num_parts)
+            self.seq = self.seq[int(part_index) * n:(int(part_index) + 1) * n]
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.shuffle = shuffle
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc(self.data_name,
+                             (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [_io.DataDesc(self.label_name,
+                             (self.batch_size, self.label_width)
+                             if self.label_width > 1 else (self.batch_size,))]
+
+    def reset(self):
+        if self.shuffle and self.seq is not None:
+            _pyrandom.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+
+    def next_sample(self):
+        """Return ``(label, decoded-image NDArray)`` for the next sample
+        (``image.py:454-477``)."""
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = recordio.unpack(s)
+                if self.imglist is None:
+                    return header.label, img
+                return self.imglist[idx][0], img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = recordio.unpack(s)
+        return header.label, img
+
+    def next(self):
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, c, h, w), dtype=np.float32)
+        batch_label = np.zeros((batch_size, self.label_width), np.float32) \
+            if self.label_width > 1 else np.zeros((batch_size,), np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = [self.imdecode(s)]
+                if not self.check_valid_image(data):
+                    continue
+                data = self.augmentation_transform(data)
+                for datum in data:
+                    assert i < batch_size, \
+                        "Batch size must be a multiple of augmentation factor"
+                    batch_data[i] = self.postprocess_data(datum)
+                    if self.label_width > 1:
+                        batch_label[i] = np.ravel(label)[:self.label_width]
+                    else:
+                        batch_label[i] = float(np.ravel(label)[0]) \
+                            if np.ndim(label) else float(label)
+                    i += 1
+        except StopIteration:
+            if i == 0:
+                raise
+        pad = batch_size - i
+        return _io.DataBatch(data=[nd.array(batch_data)],
+                             label=[nd.array(batch_label)],
+                             pad=pad, index=None,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+    def check_data_shape(self, data_shape):
+        if not len(data_shape) == 3:
+            raise ValueError("data_shape should have length 3, with "
+                             "dimensions CxHxW")
+        if not data_shape[0] == 3:
+            raise ValueError("This iterator expects inputs to have 3 channels.")
+
+    def check_valid_image(self, data):
+        return len(_to_np(data[0]).shape) != 0
+
+    def imdecode(self, s):
+        """Decode to a host array (numpy): keeps the whole augmentation
+        pipeline off-device; :meth:`next` stages one device array per
+        batch."""
+        return _imdecode_np(s)
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root or ".", fname), "rb") as fin:
+            return fin.read()
+
+    def augmentation_transform(self, data):
+        for aug in self.auglist:
+            data = [ret for src in data for ret in aug(src)]
+        return data
+
+    def postprocess_data(self, datum):
+        """HWC → CHW float32 (``image.py:533-535``)."""
+        return np.transpose(_to_np(datum).astype(np.float32), (2, 0, 1))

@@ -1,0 +1,127 @@
+"""Resource manager: shared per-device resources ops can request.
+
+Reference: ``include/mxnet/resource.h:18-76`` + ``src/resource.cc:66-255``.
+Ops there declare ``ResourceRequest{kRandom | kTempSpace}`` and the manager
+hands back a per-device resource — a seeded mshadow PRNG or a growable
+scratch buffer — decoupling op code from allocation and seeding.
+
+TPU mapping (SURVEY §7 hard-part 5): the *random* resource wraps the
+functional JAX key chain from :mod:`mxnet_tpu.random` behind a stateful
+``get_key()`` counter, so op signatures stay reference-shaped while every
+draw stays reproducible and jit-safe.  The *temp-space* resource is a
+size-tracked host scratch buffer from :class:`mxnet_tpu.storage.Storage`
+(on-device scratch is XLA's job — its buffer assignment allocates per-op
+temporaries inside the compiled program, which is precisely what
+``kTempSpace`` existed to do manually).
+"""
+import threading
+
+import numpy as np
+
+from . import random as _random
+from .base import current_context
+from .storage import Storage
+
+__all__ = ["ResourceRequest", "Resource", "ResourceManager"]
+
+
+class ResourceRequest(object):
+    """Resource type tags (``resource.h:18-36``)."""
+    kRandom = 0
+    kTempSpace = 1
+
+    def __init__(self, type_):
+        self.type = type_
+
+
+class Resource(object):
+    """A granted resource (``resource.h:39-76``)."""
+
+    def __init__(self, req, ctx, seed=None):
+        self.req = req
+        self.ctx = ctx
+        self._seed = seed
+        self._count = 0
+        self._handle = None
+        self._mu = threading.Lock()
+
+    # --- kRandom ---
+    def get_key(self):
+        """Next PRNG key — the analog of ``get_random<xpu>()->stream``:
+        stateful counter over a functional key chain."""
+        assert self.req.type == ResourceRequest.kRandom
+        with self._mu:
+            self._count += 1
+            n = self._count
+        import jax
+        base = jax.random.key(self._seed) if self._seed is not None \
+            else _random.next_key()
+        return jax.random.fold_in(base, n) if self._seed is not None else base
+
+    def seed(self, s):
+        """Re-seed this resource (``MXRandomSeed`` fans out to every
+        device's random resource, ``src/resource.cc:112-119``)."""
+        with self._mu:
+            self._seed = int(s)
+            self._count = 0
+
+    # --- kTempSpace ---
+    def get_space(self, nbytes):
+        """Scratch buffer of ≥ nbytes, grown monotonically like
+        ``ResourceTempSpace`` (``src/resource.cc:153-205``)."""
+        assert self.req.type == ResourceRequest.kTempSpace
+        with self._mu:
+            if self._handle is None or self._handle.size < nbytes:
+                if self._handle is not None:
+                    Storage.get().free(self._handle)
+                self._handle = Storage.get().alloc(nbytes, self.ctx)
+            return self._handle.data[:nbytes]
+
+    def get_host_space(self, shape, dtype=np.float32):
+        """Typed view over :meth:`get_space`."""
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        return self.get_space(nbytes).view(dtype)[:int(np.prod(shape))] \
+            .reshape(shape)
+
+
+class ResourceManager(object):
+    """Per-context resource singleton (``ResourceManagerImpl``,
+    ``src/resource.cc:66-255``)."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @staticmethod
+    def get():
+        with ResourceManager._lock:
+            if ResourceManager._instance is None:
+                ResourceManager._instance = ResourceManager()
+        return ResourceManager._instance
+
+    def __init__(self):
+        self._resources = {}
+        self._mu = threading.Lock()
+
+    def request(self, ctx=None, req=None):
+        """Grant the shared per-context resource for ``req``."""
+        ctx = ctx or current_context()
+        if req is None:
+            req = ResourceRequest(ResourceRequest.kTempSpace)
+        key = (ctx.device_type, ctx.device_id, req.type)
+        with self._mu:
+            if key not in self._resources:
+                self._resources[key] = Resource(req, ctx)
+            return self._resources[key]
+
+    # decorrelates per-device streams like the reference's
+    # `seed * kMaxNumGPUs + dev_id` (src/resource.cc:112-119)
+    _SEED_STRIDE = 4096
+
+    def seed_random(self, s):
+        """Global re-seed: root chain + every random resource, with the
+        device id folded in so replicas draw distinct streams."""
+        _random.seed(s)
+        with self._mu:
+            for (dt, di, t), res in self._resources.items():
+                if t == ResourceRequest.kRandom:
+                    res.seed(int(s) * self._SEED_STRIDE + di)

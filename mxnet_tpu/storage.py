@@ -1,0 +1,143 @@
+"""Device-memory accounting: the TPU analog of the storage manager.
+
+The reference's ``Storage::Get()->Alloc(size, Context)/Free/DirectFree``
+(``include/mxnet/storage.h:17-75``) hands out raw device pointers from a
+per-(devtype, devid) manager — naive malloc on CPU, a size-bucketed pool on
+GPU that recycles freed blocks and flushes the pool on OOM
+(``src/storage/pooled_storage_manager.h:28-103``).
+
+On TPU, XLA owns HBM: real allocation happens inside jax.Array creation
+and the compiled executable's arena, so a user-visible allocator would
+fight the runtime.  What survives is the *accounting and pooling contract*:
+
+* ``Storage.get().alloc(size, ctx)`` returns a :class:`Handle` backed by a
+  host-pinned numpy buffer (staging memory for IO, like the reference's
+  ``kCPUPinned``) while tracking per-context live/peak bytes;
+* freed blocks are recycled by rounded size exactly like
+  ``GPUPooledStorageManager::GetNextSize``;
+* ``device_memory_stats(ctx)`` surfaces XLA's own HBM telemetry
+  (``jax.Device.memory_stats()``), which is the number the reference's
+  pool would have tracked.
+"""
+import threading
+
+import numpy as np
+
+from .base import Context, current_context
+
+__all__ = ["Handle", "Storage", "device_memory_stats"]
+
+
+class Handle(object):
+    """A storage handle: ``{data, size, ctx}`` mirroring
+    ``Storage::Handle{dptr, size, ctx}`` (``storage.h:24-40``)."""
+
+    __slots__ = ("data", "size", "ctx", "_freed")
+
+    def __init__(self, data, size, ctx):
+        self.data = data
+        self.size = int(size)
+        self.ctx = ctx
+        self._freed = False
+
+
+def _round_size(size):
+    """Round to the next power of two ≥ 32B — the pool bucket rule of
+    ``GPUPooledStorageManager`` (``pooled_storage_manager.h:68-75``)."""
+    size = max(int(size), 32)
+    return 1 << (size - 1).bit_length()
+
+
+class Storage(object):
+    """Singleton pooled allocator with per-context accounting."""
+
+    _instance = None
+    _lock = threading.Lock()
+
+    @staticmethod
+    def get():
+        with Storage._lock:
+            if Storage._instance is None:
+                Storage._instance = Storage()
+        return Storage._instance
+
+    def __init__(self):
+        self._pools = {}        # ctx-key -> {rounded_size: [np buffers]}
+        self._live = {}         # ctx-key -> bytes currently allocated
+        self._peak = {}         # ctx-key -> high-water mark
+        self._pooled = {}       # ctx-key -> bytes sitting in the free pool
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def _key(ctx):
+        ctx = ctx or current_context()
+        return (ctx.device_type, ctx.device_id)
+
+    def alloc(self, size, ctx=None):
+        """Return a :class:`Handle` of ≥ ``size`` bytes, recycling a pooled
+        block when one of the right bucket exists."""
+        ctx = ctx or current_context()
+        key = self._key(ctx)
+        rounded = _round_size(size)
+        with self._mu:
+            bucket = self._pools.setdefault(key, {}).setdefault(rounded, [])
+            if bucket:
+                data = bucket.pop()
+                self._pooled[key] -= rounded
+            else:
+                data = np.empty(rounded, dtype=np.uint8)
+            self._live[key] = self._live.get(key, 0) + rounded
+            self._peak[key] = max(self._peak.get(key, 0), self._live[key])
+        return Handle(data, size, ctx)
+
+    def free(self, handle):
+        """Return the block to the pool (reference ``Free`` recycles;
+        ``pooled_storage_manager.h:46-52``)."""
+        key = self._key(handle.ctx)
+        rounded = _round_size(handle.size)
+        with self._mu:
+            if handle._freed:
+                return
+            handle._freed = True
+            self._pools.setdefault(key, {}).setdefault(rounded, []).append(
+                handle.data)
+            self._live[key] = self._live.get(key, 0) - rounded
+            self._pooled[key] = self._pooled.get(key, 0) + rounded
+
+    def direct_free(self, handle):
+        """Free without pooling (``DirectFree``, ``storage.h:57-63``)."""
+        key = self._key(handle.ctx)
+        with self._mu:
+            if handle._freed:
+                return
+            handle._freed = True
+            self._live[key] = self._live.get(key, 0) - _round_size(handle.size)
+        handle.data = None
+
+    def release_all(self, ctx=None):
+        """Drop the free pool — the reference's on-OOM ``ReleaseAll``
+        (``pooled_storage_manager.h:77-84``)."""
+        key = self._key(ctx)
+        with self._mu:
+            self._pools.pop(key, None)
+            self._pooled[key] = 0
+
+    def used_memory(self, ctx=None):
+        return self._live.get(self._key(ctx), 0)
+
+    def peak_memory(self, ctx=None):
+        return self._peak.get(self._key(ctx), 0)
+
+    def pooled_memory(self, ctx=None):
+        return self._pooled.get(self._key(ctx), 0)
+
+
+def device_memory_stats(ctx=None):
+    """XLA's HBM telemetry for a device: ``bytes_in_use``, ``peak_bytes_in_use``,
+    ``bytes_limit`` (subset varies by backend; empty dict on CPU)."""
+    import jax
+    ctx = ctx or current_context()
+    devices = jax.devices()
+    idx = min(ctx.device_id, len(devices) - 1)
+    stats = devices[idx].memory_stats()
+    return dict(stats) if stats else {}

@@ -1,0 +1,210 @@
+"""Tests for mx.image (python image pipeline).
+
+Models the reference's image tests: decode round-trip, resize/crop
+geometry, normalization math, augmenter composition, and ImageIter over a
+generated RecordIO file (reference ``python/mxnet/image.py`` +
+``tests/python/unittest/test_io.py`` style)."""
+import io as pyio
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def _np(x):
+    return x.asnumpy() if hasattr(x, "asnumpy") else np.asarray(x)
+
+
+def _jpeg_bytes(arr):
+    buf = pyio.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    return buf.getvalue()
+
+
+def _rand_img(h=48, w=64, seed=0):
+    """Smooth gradient + low-freq noise: JPEG-compressible test image."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:h, 0:w]
+    base = np.stack([(yy * 255.0 / h), (xx * 255.0 / w),
+                     ((yy + xx) * 127.0 / (h + w))], axis=2)
+    noise = rng.randint(0, 32, (h // 8 + 1, w // 8 + 1, 3))
+    noise = np.kron(noise, np.ones((8, 8, 1)))[:h, :w]
+    return np.clip(base + noise, 0, 255).astype(np.uint8)
+
+
+def test_imdecode_rgb_roundtrip():
+    arr = _rand_img()
+    out = image.imdecode(_jpeg_bytes(arr)).asnumpy()
+    assert out.shape == arr.shape and out.dtype == np.uint8
+    # JPEG is lossy; mean error should still be small
+    assert np.abs(out.astype(int) - arr.astype(int)).mean() < 20
+
+
+def test_imdecode_bgr_and_gray():
+    arr = _rand_img()
+    rgb = image.imdecode(_jpeg_bytes(arr), to_rgb=True).asnumpy()
+    bgr = image.imdecode(_jpeg_bytes(arr), to_rgb=False).asnumpy()
+    np.testing.assert_array_equal(rgb[:, :, ::-1], bgr)
+    gray = image.imdecode(_jpeg_bytes(arr), flag=0).asnumpy()
+    assert gray.shape == (48, 64, 1)
+
+
+def test_imresize_and_resize_short():
+    arr = _rand_img(40, 80)
+    out = _np(image.imresize(arr, 20, 10))
+    assert out.shape == (10, 20, 3)
+    short = _np(image.resize_short(arr, 32))
+    assert short.shape == (32, 64, 3)  # short edge 40 -> 32, long scales
+    tall = _np(image.resize_short(_rand_img(80, 40), 32))
+    assert tall.shape == (64, 32, 3)
+
+
+def test_scale_down():
+    # reference semantics (image.py:45-53): shrink keeping size's aspect
+    assert image.scale_down((48, 64), (32, 32)) == (32, 32)
+    assert image.scale_down((16, 64), (32, 32)) == (16, 16)
+    assert image.scale_down((64, 16), (32, 32)) == (16, 16)
+
+
+def test_crops():
+    arr = _rand_img(40, 60)
+    fc = _np(image.fixed_crop(arr, 5, 10, 20, 15))
+    np.testing.assert_array_equal(fc, arr[10:25, 5:25])
+    cc, roi = image.center_crop(arr, (32, 32))
+    assert _np(cc).shape == (32, 32, 3)
+    x0, y0, w, h = roi
+    assert x0 == (60 - w) // 2 and y0 == (40 - h) // 2
+    rc, roi = image.random_crop(arr, (24, 24))
+    assert rc.shape == (24, 24, 3)
+    rsc, _ = image.random_size_crop(arr, (24, 24), 0.5, (0.75, 1.333))
+    assert rsc.shape == (24, 24, 3)
+
+
+def test_color_normalize():
+    arr = _rand_img()
+    mean = np.array([1.0, 2.0, 3.0], np.float32)
+    std = np.array([2.0, 2.0, 2.0], np.float32)
+    out = _np(image.color_normalize(arr, mean, std))
+    np.testing.assert_allclose(out, (arr - mean) / std, rtol=1e-5)
+
+
+def test_flip_and_cast_augs():
+    arr = _rand_img()
+    flip = _np(image.HorizontalFlipAug(1.0)(arr)[0])
+    np.testing.assert_array_equal(flip, arr[:, ::-1, :])
+    cast = _np(image.CastAug()(arr)[0])
+    assert cast.dtype == np.float32
+
+
+def test_create_augmenter_pipeline():
+    augs = image.CreateAugmenter((3, 32, 32), resize=36, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, pca_noise=0.1)
+    data = [mx.nd.array(_rand_img())]
+    for aug in augs:
+        data = [r for src in data for r in aug(src)]
+    out = _np(data[0])
+    assert out.shape == (32, 32, 3) and out.dtype == np.float32
+
+
+def _write_rec(tmpdir, n=12, h=48, w=64):
+    rec_path = os.path.join(str(tmpdir), "data.rec")
+    idx_path = os.path.join(str(tmpdir), "data.idx")
+    rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "w")
+    for i in range(n):
+        img = _rand_img(h, w, seed=i)
+        hdr = recordio.IRHeader(0, float(i % 4), i, 0)
+        rec.write_idx(i, recordio.pack(hdr, _jpeg_bytes(img)))
+    rec.close()
+    return rec_path, idx_path
+
+
+def test_image_iter_recordio(tmp_path):
+    rec_path, idx_path = _write_rec(tmp_path)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=rec_path, path_imgidx=idx_path,
+                         shuffle=True)
+    nbatch = 0
+    labels = []
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 32, 32)
+        assert batch.label[0].shape == (4,)
+        labels.extend(batch.label[0].asnumpy()[:4 - batch.pad].tolist())
+        nbatch += 1
+    assert nbatch == 3
+    assert sorted(labels) == sorted([float(i % 4) for i in range(12)])
+    it.reset()
+    assert next(it).data[0].shape == (4, 3, 32, 32)
+
+
+def test_image_iter_imglist(tmp_path):
+    # raw image files + in-memory imglist
+    names = []
+    for i in range(6):
+        fname = "img%d.jpg" % i
+        Image.fromarray(_rand_img(seed=i)).save(str(tmp_path / fname))
+        names.append((float(i), fname))
+    it = image.ImageIter(batch_size=3, data_shape=(3, 24, 24),
+                         imglist=[[lab, fn] for lab, fn in names],
+                         path_root=str(tmp_path))
+    batch = next(it)
+    assert batch.data[0].shape == (3, 3, 24, 24)
+
+
+def test_imread_imwrite_roundtrip(tmp_path):
+    arr = _rand_img()
+    p = str(tmp_path / "x.jpg")
+    Image.fromarray(arr).save(p, quality=95)
+    out = image.imread(p).asnumpy()
+    assert out.shape == arr.shape
+
+
+def test_create_augmenter_std_only():
+    # regression: std without mean must not crash (ColorNormalizeAug(None, std))
+    augs = image.CreateAugmenter((3, 16, 16), std=True)
+    data = [mx.nd.array(_rand_img(24, 24))]
+    for aug in augs:
+        data = [r for src in data for r in aug(src)]
+    assert _np(data[0]).shape == (16, 16, 3)
+
+
+def test_imresize_float_input():
+    # regression: reference cv2.resize accepts float images
+    arr = _rand_img(20, 30).astype(np.float32)
+    out = _np(image.imresize(arr, 15, 10))
+    assert out.shape == (10, 15, 3) and out.dtype == np.float32
+
+
+def test_shuffle_without_index_raises(tmp_path):
+    rec_path = str(tmp_path / "noidx.rec")
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i in range(4):
+        hdr = recordio.IRHeader(0, float(i), i, 0)
+        rec.write(recordio.pack(hdr, _jpeg_bytes(_rand_img(seed=i))))
+    rec.close()
+    with pytest.raises(ValueError):
+        image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
+                        path_imgrec=rec_path, shuffle=True)
+
+
+def test_storage_concurrent_double_free():
+    import threading
+    from mxnet_tpu.storage import Storage
+    st = Storage.get()
+    ctx = mx.cpu(11)
+    h = st.alloc(128, ctx)
+    threads = [threading.Thread(target=st.free, args=(h,)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly one free must take effect
+    assert st.used_memory(ctx) == 0
+    assert st.pooled_memory(ctx) == 128  # one 128B bucket entry, not 8
