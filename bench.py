@@ -40,20 +40,26 @@ def main():
     # stage once in HBM (synthetic-data mode measures compute, not PCIe)
     batch_dict = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(y)}
 
+    def sync(outs):
+        # on the axon remote backend ``block_until_ready`` does not
+        # actually block; a device→host transfer is the only honest
+        # completion barrier, so fetch one scalar of the output
+        np.asarray(outs[0].data[:1, :1])
+
     # warmup (compile)
     for _ in range(2):
         outs = trainer.step(batch_dict)
-        jax.block_until_ready(outs[0].data)
+    sync(outs)
 
-    # sync every step: honest wall-clock including dispatch latency
-    times = []
+    # steps chain through the donated parameter state, so one scalar
+    # fetch at the end forces the whole timed sequence to completion
+    t0 = time.perf_counter()
     for _ in range(steps):
-        t0 = time.perf_counter()
         outs = trainer.step(batch_dict)
-        jax.block_until_ready(outs[0].data)
-        times.append(time.perf_counter() - t0)
+    sync(outs)
+    elapsed = time.perf_counter() - t0
 
-    img_s = batch / float(np.median(times))
+    img_s = batch * steps / elapsed
     print(json.dumps({
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(img_s, 2),

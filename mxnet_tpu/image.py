@@ -91,15 +91,18 @@ def imresize(src, w, h, interp=2):
     if arr.dtype == np.uint8:
         pil = Image.fromarray(arr[:, :, 0] if squeeze else arr)
         out = np.asarray(pil.resize((int(w), int(h)), _interp(interp)))
+    elif arr.ndim == 2:
+        out = np.asarray(
+            Image.fromarray(arr.astype(np.float32), mode="F")
+            .resize((int(w), int(h)), _interp(interp))).astype(arr.dtype)
     else:
         # PIL can't build a multi-channel float image; resize channel-wise
         # through float32 'F' mode planes
         planes = [np.asarray(
             Image.fromarray(arr[:, :, c].astype(np.float32), mode="F")
             .resize((int(w), int(h)), _interp(interp)))
-            for c in range(arr.shape[2] if arr.ndim == 3 else 1)]
-        out = np.stack(planes, axis=2).astype(arr.dtype) \
-            if arr.ndim == 3 else planes[0].astype(arr.dtype)
+            for c in range(arr.shape[2])]
+        out = np.stack(planes, axis=2).astype(arr.dtype)
         squeeze = False
     if squeeze:
         out = out[:, :, None]
@@ -126,7 +129,7 @@ def resize_short(src, size, interp=2):
         new_w, new_h = size, int(size * h / w)
     else:
         new_w, new_h = int(size * w / h), size
-    return imresize(arr, new_w, new_h, interp=interp)
+    return imresize(src, new_w, new_h, interp=interp)
 
 
 def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
@@ -411,6 +414,11 @@ class ImageIter(_io.DataIter):
         else:
             self.seq = None
 
+        if (self.imglist is not None and self.imgrec is not None
+                and self.imgidx is None):
+            raise ValueError("path_imgidx is required when an image list is "
+                             "used together with path_imgrec (random access "
+                             "by list key needs an indexed record file)")
         if (shuffle or num_parts > 1) and self.seq is None:
             raise ValueError("shuffle/num_parts>1 need random access: "
                              "provide path_imgidx or an image list")

@@ -192,19 +192,3 @@ def test_shuffle_without_index_raises(tmp_path):
     with pytest.raises(ValueError):
         image.ImageIter(batch_size=2, data_shape=(3, 16, 16),
                         path_imgrec=rec_path, shuffle=True)
-
-
-def test_storage_concurrent_double_free():
-    import threading
-    from mxnet_tpu.storage import Storage
-    st = Storage.get()
-    ctx = mx.cpu(11)
-    h = st.alloc(128, ctx)
-    threads = [threading.Thread(target=st.free, args=(h,)) for _ in range(8)]
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    # exactly one free must take effect
-    assert st.used_memory(ctx) == 0
-    assert st.pooled_memory(ctx) == 128  # one 128B bucket entry, not 8

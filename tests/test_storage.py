@@ -85,3 +85,19 @@ def test_manager_shares_per_context():
     r1 = mgr.request(mx.cpu(9), ResourceRequest(ResourceRequest.kTempSpace))
     r2 = mgr.request(mx.cpu(9), ResourceRequest(ResourceRequest.kTempSpace))
     assert r1 is r2
+
+
+def test_storage_concurrent_double_free():
+    import threading
+    from mxnet_tpu.storage import Storage
+    st = Storage.get()
+    ctx = mx.cpu(11)
+    h = st.alloc(128, ctx)
+    threads = [threading.Thread(target=st.free, args=(h,)) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly one free must take effect
+    assert st.used_memory(ctx) == 0
+    assert st.pooled_memory(ctx) == 128  # one 128B bucket entry, not 8
