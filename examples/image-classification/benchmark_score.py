@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Inference throughput for the model zoo (reference
+``example/image-classification/benchmark_score.py``): forward-only img/s
+per batch size, compiled once per shape, honest device sync."""
+import argparse
+import logging
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import models
+
+logging.basicConfig(level=logging.INFO)
+
+
+def score(network, batch_size, image_shape=(3, 224, 224), num_batches=10,
+          dtype="float32"):
+    sym = models.get_symbol(network, num_classes=1000)
+    data_shape = (batch_size,) + image_shape
+    mod = mx.mod.Module(symbol=sym, context=mx.tpu())
+    mod.bind(for_training=False, inputs_need_grad=False,
+             data_shapes=[mx.io.DataDesc("data", data_shape)])
+    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    rng = np.random.RandomState(0)
+    batch = mx.io.DataBatch(
+        data=[mx.nd.array(rng.uniform(-1, 1, data_shape)
+                          .astype(dtype))], label=[])
+
+    def sync():
+        # scalar fetch = completion barrier (block_until_ready is a
+        # no-op on remote TPU backends)
+        np.asarray(mod.get_outputs()[0].data[:1, :1])
+
+    for _ in range(2):                       # compile + warmup
+        mod.forward(batch, is_train=False)
+    sync()
+    tic = time.time()
+    for _ in range(num_batches):
+        mod.forward(batch, is_train=False)
+    sync()
+    return num_batches * batch_size / (time.time() - tic)
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description="score the model zoo")
+    parser.add_argument("--networks", type=str,
+                        default="alexnet,vgg,inception-bn,inception-v3,"
+                                "resnet-50,resnet-152")
+    parser.add_argument("--batch-sizes", type=str, default="1,32")
+    args = parser.parse_args()
+    for net in args.networks.split(","):
+        for b in (int(x) for x in args.batch_sizes.split(",")):
+            speed = score(net, b)
+            logging.info("network: %s, batch size: %d, image/sec: %.2f",
+                         net, b, speed)

@@ -1,0 +1,84 @@
+"""Data iterator builders for the image-classification examples
+(reference ``example/image-classification/common/data.py``).
+
+``--benchmark 1`` swaps the real dataset for synthetic random data, the
+reference's trick for measuring pure training throughput without an input
+pipeline (``common/fit.py``)."""
+import argparse
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def add_data_args(parser):
+    data = parser.add_argument_group("Data")
+    data.add_argument("--data-train", type=str, help="training record file")
+    data.add_argument("--data-val", type=str, help="validation record file")
+    data.add_argument("--image-shape", type=str, default="3,224,224")
+    data.add_argument("--num-examples", type=int, default=1281167)
+    data.add_argument("--mean-r", type=float, default=123.68)
+    data.add_argument("--mean-g", type=float, default=116.779)
+    data.add_argument("--mean-b", type=float, default=103.939)
+    data.add_argument("--pad-size", type=int, default=0)
+    data.add_argument("--benchmark", type=int, default=0,
+                      help="1 = use synthetic data to measure throughput")
+    return data
+
+
+class SyntheticDataIter(mx.io.DataIter):
+    """Random images/labels staged once and replayed — measures the
+    train step, not host→device copies."""
+
+    def __init__(self, num_classes, data_shape, max_iter, dtype="float32"):
+        super(SyntheticDataIter, self).__init__(batch_size=data_shape[0])
+        self.cur_iter = 0
+        self.max_iter = max_iter
+        rng = np.random.RandomState(0)
+        data = rng.uniform(-1, 1, data_shape).astype(dtype)
+        label = rng.randint(0, num_classes,
+                            (data_shape[0],)).astype(np.float32)
+        self.data = mx.nd.array(data)
+        self.label = mx.nd.array(label)
+        self.provide_data = [mx.io.DataDesc("data", data_shape)]
+        self.provide_label = [mx.io.DataDesc("softmax_label",
+                                             (data_shape[0],))]
+
+    def next(self):
+        self.cur_iter += 1
+        if self.cur_iter > self.max_iter:
+            raise StopIteration
+        return mx.io.DataBatch(data=[self.data], label=[self.label], pad=0)
+
+    def reset(self):
+        self.cur_iter = 0
+
+
+def get_rec_iter(args, kv=None):
+    image_shape = tuple(int(i) for i in args.image_shape.split(","))
+    if args.benchmark:
+        train = SyntheticDataIter(args.num_classes,
+                                  (args.batch_size,) + image_shape,
+                                  max_iter=500)
+        return train, None
+    rank, nworker = (kv.rank, kv.num_workers) if kv else (0, 1)
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        shuffle=True,
+        rand_crop=True,
+        rand_mirror=True,
+        mean_r=args.mean_r, mean_g=args.mean_g, mean_b=args.mean_b,
+        num_parts=nworker, part_index=rank)
+    if not args.data_val:
+        return train, None
+    val = mx.io.ImageRecordIter(
+        path_imgrec=args.data_val,
+        data_shape=image_shape,
+        batch_size=args.batch_size,
+        shuffle=False,
+        mean_r=args.mean_r, mean_g=args.mean_g, mean_b=args.mean_b,
+        num_parts=nworker, part_index=rank)
+    return train, val

@@ -1,0 +1,148 @@
+"""Generic training harness for the image-classification examples
+(reference ``example/image-classification/common/fit.py:96-186``): builds
+the kvstore, optimizer, lr schedule and callbacks, then calls
+``Module.fit``."""
+import argparse
+import logging
+import os
+import time
+
+import mxnet_tpu as mx
+
+
+def _get_lr_scheduler(args, kv):
+    if not args.lr_factor or args.lr_factor >= 1:
+        return (args.lr, None)
+    epoch_size = args.num_examples // args.batch_size
+    if "dist" in args.kv_store:
+        epoch_size //= kv.num_workers
+    begin_epoch = args.load_epoch if args.load_epoch else 0
+    step_epochs = [int(l) for l in args.lr_step_epochs.split(",")]
+    lr = args.lr
+    for s in step_epochs:
+        if begin_epoch >= s:
+            lr *= args.lr_factor
+    if lr != args.lr:
+        logging.info("Adjust learning rate to %e for epoch %d",
+                     lr, begin_epoch)
+    steps = [epoch_size * (x - begin_epoch) for x in step_epochs
+             if x - begin_epoch > 0]
+    return (lr, mx.lr_scheduler.MultiFactorScheduler(step=steps,
+                                                     factor=args.lr_factor))
+
+
+def _load_model(args, rank=0):
+    if args.load_epoch is None:
+        return (None, None, None)
+    assert args.model_prefix is not None
+    model_prefix = args.model_prefix
+    if rank > 0 and os.path.exists("%s-%d-symbol.json"
+                                   % (model_prefix, rank)):
+        model_prefix += "-%d" % rank
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        model_prefix, args.load_epoch)
+    logging.info("Loaded model %s_%04d.params", model_prefix,
+                 args.load_epoch)
+    return (sym, arg_params, aux_params)
+
+
+def _save_model(args, rank=0):
+    if args.model_prefix is None:
+        return None
+    dst_dir = os.path.dirname(args.model_prefix)
+    if dst_dir and not os.path.isdir(dst_dir):
+        os.makedirs(dst_dir)
+    return mx.callback.do_checkpoint(
+        args.model_prefix if rank == 0
+        else "%s-%d" % (args.model_prefix, rank))
+
+
+def add_fit_args(parser):
+    train = parser.add_argument_group("Training")
+    train.add_argument("--network", type=str, help="the neural network")
+    train.add_argument("--num-layers", type=int,
+                       help="layer count for variable-depth networks")
+    train.add_argument("--gpus", type=str,
+                       help="ignored on TPU; kept for CLI compatibility")
+    train.add_argument("--kv-store", type=str, default="device")
+    train.add_argument("--num-epochs", type=int, default=100)
+    train.add_argument("--lr", type=float, default=0.1)
+    train.add_argument("--lr-factor", type=float, default=0.1)
+    train.add_argument("--lr-step-epochs", type=str, default="30,60")
+    train.add_argument("--optimizer", type=str, default="sgd")
+    train.add_argument("--mom", type=float, default=0.9)
+    train.add_argument("--wd", type=float, default=0.0001)
+    train.add_argument("--batch-size", type=int, default=128)
+    train.add_argument("--disp-batches", type=int, default=20)
+    train.add_argument("--model-prefix", type=str)
+    train.add_argument("--load-epoch", type=int)
+    train.add_argument("--top-k", type=int, default=0)
+    train.add_argument("--dtype", type=str, default="float32",
+                       help="bfloat16 enables mixed-precision training")
+    train.add_argument("--test-io", type=int, default=0,
+                       help="1 = benchmark the input pipeline only")
+    return train
+
+
+def fit(args, network, data_loader, **kwargs):
+    """Train ``network`` with data from ``data_loader(args, kv)``."""
+    kv = mx.kvstore.create(args.kv_store)
+    head = "%(asctime)-15s Node[" + str(kv.rank) + "] %(message)s"
+    logging.basicConfig(level=logging.DEBUG, format=head)
+    logging.info("start with arguments %s", args)
+
+    (train, val) = data_loader(args, kv)
+    if args.test_io:
+        tic = time.time()
+        for i, batch in enumerate(train):
+            for j in batch.data:
+                j.wait_to_read()
+            if (i + 1) % args.disp_batches == 0:
+                logging.info("Batch [%d]\tSpeed: %.2f samples/sec", i,
+                             args.disp_batches * args.batch_size /
+                             (time.time() - tic))
+                tic = time.time()
+        return
+
+    sym, arg_params, aux_params = _load_model(args, kv.rank)
+    if sym is not None:
+        assert sym.tojson() == network.tojson()
+
+    checkpoint = _save_model(args, kv.rank)
+    lr, lr_scheduler = _get_lr_scheduler(args, kv)
+
+    # --dtype bfloat16 is honored by the network factories (they Cast the
+    # input); the fully fused bf16 path is mxnet_tpu.parallel.Trainer
+    model = mx.mod.Module(context=mx.tpu(), symbol=network)
+
+    optimizer_params = {
+        "learning_rate": lr,
+        "momentum": args.mom,
+        "wd": args.wd,
+        "lr_scheduler": lr_scheduler}
+    if args.optimizer in ("adam", "adagrad", "rmsprop", "adadelta"):
+        optimizer_params.pop("momentum")
+
+    initializer = mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                 magnitude=2)
+    eval_metrics = ["accuracy"]
+    if args.top_k > 0:
+        eval_metrics.append(mx.metric.create("top_k_accuracy",
+                                             top_k=args.top_k))
+    batch_end_callbacks = [mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches)]
+    model.fit(train,
+              begin_epoch=args.load_epoch if args.load_epoch else 0,
+              num_epoch=args.num_epochs,
+              eval_data=val,
+              eval_metric=eval_metrics,
+              kvstore=kv,
+              optimizer=args.optimizer,
+              optimizer_params=optimizer_params,
+              initializer=initializer,
+              arg_params=arg_params,
+              aux_params=aux_params,
+              batch_end_callback=batch_end_callbacks,
+              epoch_end_callback=checkpoint,
+              allow_missing=True,
+              **kwargs)

@@ -1,0 +1,39 @@
+#!/usr/bin/env python
+"""Train on CIFAR-10 record files (reference
+``example/image-classification/train_cifar10.py``).  Expects
+``cifar10_train.rec``/``cifar10_val.rec`` made with ``tools/im2rec.py``;
+``--benchmark 1`` runs on synthetic data."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import fit, data
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train cifar10",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(
+        network="resnet", num_layers=20, batch_size=128,
+        image_shape="3,28,28", num_examples=50000,
+        data_train="data/cifar10_train.rec",
+        data_val="data/cifar10_val.rec",
+        lr=0.05, lr_factor=0.1, lr_step_epochs="100,150",
+        num_epochs=200,
+        mean_r=123.68, mean_g=116.779, mean_b=103.939)
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+    image_shape = tuple(int(i) for i in args.image_shape.split(","))
+    sym = models.get_symbol(args.network, num_classes=args.num_classes,
+                            num_layers=args.num_layers,
+                            image_shape=image_shape)
+    fit.fit(args, sym, data.get_rec_iter)

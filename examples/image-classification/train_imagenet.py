@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Train on ImageNet record files (reference
+``example/image-classification/train_imagenet.py``).  The headline
+configuration from the reference README (ResNet-50/152, Inception-v3,
+AlexNet) maps 1:1; distribution uses ``--kv-store dist_sync_tpu`` over a
+pod instead of parameter servers."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from common import fit, data
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train imagenet-1k",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(
+        network="resnet-50", batch_size=256,
+        image_shape="3,224,224", num_examples=1281167,
+        data_train="data/imagenet_train.rec",
+        data_val="data/imagenet_val.rec",
+        lr=0.1, lr_factor=0.1, lr_step_epochs="30,60,90",
+        num_epochs=90, dtype="bfloat16")
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+    sym = models.get_symbol(args.network, num_classes=args.num_classes)
+    fit.fit(args, sym, data.get_rec_iter)

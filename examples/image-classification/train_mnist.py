@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Train MLP or LeNet on MNIST (reference
+``example/image-classification/train_mnist.py``).
+
+Uses ``mx.io.MNISTIter`` when the idx files are present under
+``--data-dir``; otherwise falls back to a synthetic separable dataset so
+the example is runnable offline."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from common import fit
+
+
+def get_mnist_iter(args, kv):
+    image = os.path.join(args.data_dir, "train-images-idx3-ubyte")
+    label = os.path.join(args.data_dir, "train-labels-idx1-ubyte")
+    flat = args.network == "mlp"
+    if os.path.exists(image) or os.path.exists(image + ".gz"):
+        train = mx.io.MNISTIter(image=image, label=label,
+                                batch_size=args.batch_size, shuffle=True,
+                                flat=flat,
+                                num_parts=kv.num_workers,
+                                part_index=kv.rank)
+        val = mx.io.MNISTIter(
+            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
+            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            batch_size=args.batch_size, flat=flat)
+        return train, val
+    logging.warning("MNIST files not found under %s; using synthetic data",
+                    args.data_dir)
+    rng = np.random.RandomState(7)
+    n = 4096
+    centers = rng.normal(0, 3, (10, 784)).astype(np.float32)
+    ys = rng.randint(0, 10, n)
+    xs = (centers[ys] + rng.normal(0, 1, (n, 784)).astype(np.float32)) / 10.0
+    if not flat:
+        xs = xs.reshape(n, 1, 28, 28)
+    train = mx.io.NDArrayIter(xs[:3584], ys[:3584].astype(np.float32),
+                              args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xs[3584:], ys[3584:].astype(np.float32),
+                            args.batch_size)
+    return train, val
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="train mnist",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--num-examples", type=int, default=60000)
+    parser.add_argument("--data-dir", type=str, default="data/mnist")
+    fit.add_fit_args(parser)
+    parser.set_defaults(network="mlp", num_epochs=10,
+                        lr=0.05, lr_step_epochs="10", batch_size=64)
+    args = parser.parse_args()
+
+    from mxnet_tpu import models
+    if args.network == "mlp":
+        sym = models.mlp.get_symbol(num_classes=args.num_classes)
+    else:
+        sym = models.lenet.get_symbol(num_classes=args.num_classes)
+
+    fit.fit(args, sym, get_mnist_iter)

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Bucketing LSTM language model (reference
+``example/rnn/lstm_bucketing.py``): variable-length sentences are grouped
+into buckets; the BucketingModule compiles one XLA program per bucket
+shape (the jit-cache analog of the reference's shared-memory executors).
+
+Reads PTB-style text (one sentence per line) from ``--train-data`` /
+``--valid-data``; generates a synthetic corpus when the files are absent
+so the example runs offline."""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+parser = argparse.ArgumentParser(
+    description="Train an LSTM language model with bucketing",
+    formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+parser.add_argument("--train-data", type=str, default="data/ptb.train.txt")
+parser.add_argument("--valid-data", type=str, default="data/ptb.valid.txt")
+parser.add_argument("--num-layers", type=int, default=2)
+parser.add_argument("--num-hidden", type=int, default=200)
+parser.add_argument("--num-embed", type=int, default=200)
+parser.add_argument("--num-epochs", type=int, default=25)
+parser.add_argument("--lr", type=float, default=0.01)
+parser.add_argument("--optimizer", type=str, default="sgd")
+parser.add_argument("--mom", type=float, default=0.0)
+parser.add_argument("--wd", type=float, default=0.00001)
+parser.add_argument("--batch-size", type=int, default=32)
+parser.add_argument("--disp-batches", type=int, default=50)
+parser.add_argument("--kv-store", type=str, default="device")
+
+buckets = [10, 20, 30, 40, 50, 60]
+start_label = 1
+invalid_label = 0
+
+
+def tokenize_text(fname, vocab=None, invalid_label=-1, start_label=0):
+    with open(fname) as f:
+        lines = f.readlines()
+    lines = [line.split() for line in lines]
+    sentences, vocab = mx.rnn.encode_sentences(
+        lines, vocab=vocab, invalid_label=invalid_label,
+        start_label=start_label)
+    return sentences, vocab
+
+
+def synthetic_corpus(vocab_size=200, n=2000, seed=0):
+    """Markov-ish random sentences with bucketable length spread."""
+    rng = np.random.RandomState(seed)
+    sents = []
+    for _ in range(n):
+        length = int(rng.choice(buckets)) - rng.randint(0, 5)
+        state = rng.randint(start_label, vocab_size)
+        sent = []
+        for _ in range(max(length, 2)):
+            state = (state * 31 + rng.randint(0, 7)) % vocab_size
+            sent.append(max(state, start_label))
+        sents.append(sent)
+    return sents, {i: i for i in range(vocab_size)}
+
+
+if __name__ == "__main__":
+    args = parser.parse_args()
+    head = "%(asctime)-15s %(message)s"
+    logging.basicConfig(level=logging.DEBUG, format=head)
+
+    if os.path.exists(args.train_data):
+        train_sent, vocab = tokenize_text(
+            args.train_data, start_label=start_label,
+            invalid_label=invalid_label)
+        val_sent, _ = tokenize_text(
+            args.valid_data, vocab=vocab, start_label=start_label,
+            invalid_label=invalid_label)
+    else:
+        logging.warning("%s not found; using a synthetic corpus",
+                        args.train_data)
+        corpus, vocab = synthetic_corpus()
+        train_sent, val_sent = corpus[:1600], corpus[1600:]
+
+    data_train = mx.rnn.BucketSentenceIter(train_sent, args.batch_size,
+                                           buckets=buckets,
+                                           invalid_label=invalid_label)
+    data_val = mx.rnn.BucketSentenceIter(val_sent, args.batch_size,
+                                         buckets=buckets,
+                                         invalid_label=invalid_label)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix="lstm_l%d_" % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable("data")
+        label = mx.sym.Variable("softmax_label")
+        embed = mx.sym.Embedding(data=data, input_dim=len(vocab),
+                                 output_dim=args.num_embed, name="embed")
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=len(vocab),
+                                     name="pred")
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen,
+        default_bucket_key=data_train.default_bucket_key,
+        context=mx.tpu())
+
+    model.fit(
+        train_data=data_train,
+        eval_data=data_val,
+        eval_metric=mx.metric.Perplexity(invalid_label),
+        kvstore=args.kv_store,
+        optimizer=args.optimizer,
+        optimizer_params={"learning_rate": args.lr, "momentum": args.mom,
+                          "wd": args.wd},
+        initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+        num_epoch=args.num_epochs,
+        batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                   args.disp_batches))

@@ -58,8 +58,19 @@ def _load_general(data, targets):
     for d_src, d_targets in zip(data, targets):
         if isinstance(d_targets, nd.NDArray):
             d_src.copyto(d_targets)
+        elif isinstance(d_src, nd.NDArray):
+            # slice on-device (XLA slice): no host round trip per batch
+            for slice_idx, d_dst in d_targets:
+                piece = d_src.data[slice_idx].astype(d_dst.dtype)
+                if tuple(piece.shape) != tuple(d_dst.shape):
+                    raise MXNetError(
+                        "array shape do not match the shape of NDArray: "
+                        "%s vs %s" % (piece.shape, d_dst.shape))
+                if d_dst.context != d_src.context:
+                    piece = nd._place(piece, d_dst.context)
+                d_dst._set_data(piece)
         else:
-            src = d_src.asnumpy()
+            src = np.asarray(d_src)
             for slice_idx, d_dst in d_targets:
                 d_dst._sync_copyfrom(src[slice_idx])
 

@@ -437,14 +437,19 @@ def _l2_normalization(p, c, a):
                               Param("nsize", int, required=True)),
           hint="lrn")
 def _lrn(p, c, a):
-    half = p["nsize"] // 2
+    nsize = p["nsize"]
+    half = nsize // 2
     sq = a * a
-    # sliding window sum over channel axis
-    window_sum = lax.reduce_window(
-        sq, jnp.array(0, a.dtype), lax.add,
-        (1, p["nsize"]) + (1,) * (a.ndim - 2),
-        (1,) * a.ndim,
-        ((0, 0), (half, half)) + ((0, 0),) * (a.ndim - 2))
+    # sliding window sum over the channel axis, unrolled into nsize
+    # shifted adds (nsize is tiny; avoids a reduce_window the TPU
+    # backend mis-lowers when padding a non-spatial dim)
+    C = a.shape[1]
+    pad = [(0, 0)] * a.ndim
+    pad[1] = (half, half)
+    sq_pad = jnp.pad(sq, pad)
+    window_sum = sq_pad[:, 0:C]
+    for i in range(1, nsize):
+        window_sum = window_sum + lax.slice_in_dim(sq_pad, i, i + C, axis=1)
     scale = p["knorm"] + (p["alpha"] / p["nsize"]) * window_sum
     return a / jnp.power(scale, p["beta"])
 

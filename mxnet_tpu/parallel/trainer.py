@@ -184,9 +184,14 @@ class Trainer:
 
         if self.mesh is not None:
             mesh = self.mesh
-            self._batch_shardings = {
-                n: batch_sharding(mesh, len(self._input_shapes[n]))
-                for n in self._input_shapes}
+            if "data" in mesh.axis_names:
+                self._batch_shardings = {
+                    n: batch_sharding(mesh, len(self._input_shapes[n]))
+                    for n in self._input_shapes}
+            else:
+                # model/seq-only mesh: inputs replicated, params sharded
+                self._batch_shardings = {
+                    n: replicated(mesh) for n in self._input_shapes}
             rep = replicated(mesh)
             p_shard = {n: self._param_sharding(n) for n in self.param_names}
             a_shard = {n: self._param_sharding(n) for n in self.aux_names}

@@ -358,3 +358,19 @@ def test_one_hot_pick():
     check_symbolic_forward(mx.symbol.pick(d, i, axis=1),
                            {"d": a, "i": idx},
                            [a[np.arange(3), idx.astype(int)]])
+
+
+def test_lrn():
+    # golden NumPy sliding-window model of src/operator/lrn-inl.h
+    rng = np.random.RandomState(3)
+    a = rng.rand(2, 7, 3, 3).astype("f") + 0.5
+    nsize, alpha, beta, knorm = 3, 1e-2, 0.75, 2.0
+    sq = a * a
+    pad = np.pad(sq, ((0, 0), (nsize // 2, nsize // 2), (0, 0), (0, 0)))
+    win = sum(pad[:, i:i + 7] for i in range(nsize))
+    expect = a / (knorm + alpha / nsize * win) ** beta
+    x = mx.sym.Variable("x")
+    sym = mx.sym.LRN(x, nsize=nsize, alpha=alpha, beta=beta, knorm=knorm)
+    check_symbolic_forward(sym, {"x": a}, [expect])
+    check_numeric_gradient(sym, {"x": a}, numeric_eps=1e-2,
+                           rtol=0.05, atol=1e-3)

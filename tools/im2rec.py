@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Pack an image directory / list file into RecordIO, and make list files.
+
+TPU-native port of the reference packer (``tools/im2rec.py`` /
+``tools/im2rec.cc``): same ``.lst`` tab-separated format
+(``index\tlabel[s]\trelpath``) and the same record layout
+(``IRHeader`` + JPEG bytes via ``mxnet_tpu.recordio.pack_img``), so ``.rec``
+files are interchangeable with the reference's iterators.  The OMP-threaded
+C++ encoder is replaced by a multiprocessing pool feeding a single writer
+(RecordIO files are append-only; one writer, many encoders).
+"""
+import argparse
+import multiprocessing
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+import numpy as np
+
+from mxnet_tpu import recordio
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label) walking ``root``; one label id per
+    subdirectory in sorted order (reference ``im2rec.py list_image``)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in os.walk(root, followlinks=True):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as fout:
+        for i, item in enumerate(image_list):
+            line = "%d\t" % item[0]
+            for j in item[2:]:
+                line += "%f\t" % j
+            line += "%s\n" % item[1]
+            fout.write(line)
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive, args.exts))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    N = len(image_list)
+    chunk_size = (N + args.chunks - 1) // args.chunks
+    for i in range(args.chunks):
+        chunk = image_list[i * chunk_size:(i + 1) * chunk_size]
+        if args.chunks > 1:
+            str_chunk = "_%d" % i
+        else:
+            str_chunk = ""
+        sep = int(chunk_size * args.train_ratio)
+        sep_test = int(chunk_size * args.test_ratio)
+        if args.train_ratio == 1.0:
+            write_list(args.prefix + str_chunk + ".lst", chunk)
+        else:
+            if args.test_ratio:
+                write_list(args.prefix + str_chunk + "_test.lst",
+                           chunk[:sep_test])
+            if args.train_ratio + args.test_ratio < 1.0:
+                write_list(args.prefix + str_chunk + "_val.lst",
+                           chunk[sep_test + sep:])
+            write_list(args.prefix + str_chunk + "_train.lst",
+                       chunk[sep_test:sep_test + sep])
+
+
+def read_list(path_in):
+    with open(path_in) as fin:
+        for line in fin:
+            line = [i.strip() for i in line.strip().split("\t")]
+            if len(line) < 3:
+                continue
+            yield (int(line[0]),) + tuple(line[2:]) + \
+                tuple(float(i) for i in line[1:-1])
+
+
+def _encode(args, item):
+    """Worker: read + (optionally) resize/re-encode one image, return the
+    packed record bytes."""
+    from PIL import Image
+    import io as _pyio
+
+    fullpath = os.path.join(args.root, item[1])
+    header = recordio.IRHeader(0, item[2] if len(item) == 3
+                               else np.array(item[2:], dtype=np.float32),
+                               item[0], 0)
+    if args.pass_through:
+        with open(fullpath, "rb") as f:
+            return recordio.pack(header, f.read())
+    img = Image.open(fullpath).convert("RGB")
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w - s) // 2 + s, (h - s) // 2 + s))
+    if args.resize:
+        w, h = img.size
+        if min(w, h) != args.resize:
+            if w < h:
+                size = (args.resize, int(h * args.resize / w))
+            else:
+                size = (int(w * args.resize / h), args.resize)
+            img = img.resize(size, Image.BILINEAR)
+    buf = _pyio.BytesIO()
+    img.save(buf, format="JPEG" if args.encoding == ".jpg" else "PNG",
+             quality=args.quality)
+    return recordio.pack(header, buf.getvalue())
+
+
+def im2rec(args, path_lst):
+    prefix = os.path.splitext(path_lst)[0]
+    items = list(read_list(path_lst))
+    record = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    tic = time.time()
+    pool = multiprocessing.Pool(args.num_thread) if args.num_thread > 1 \
+        else None
+    try:
+        if pool is not None:
+            encoded = pool.imap(_EncodeClosure(args), items, chunksize=16)
+        else:
+            encoded = (_encode(args, it) for it in items)
+        for cnt, (item, data) in enumerate(zip(items, encoded)):
+            record.write_idx(item[0], data)
+            if cnt % 1000 == 0 and cnt > 0:
+                print("time: %.2f count: %d" % (time.time() - tic, cnt))
+                tic = time.time()
+    finally:
+        if pool is not None:
+            pool.close()
+            pool.join()
+        record.close()
+
+
+class _EncodeClosure(object):
+    """Picklable functools.partial(_encode, args)."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def __call__(self, item):
+        return _encode(self.args, item)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description="Create an image list / RecordIO dataset")
+    parser.add_argument("prefix", help="prefix of .lst/.rec/.idx files")
+    parser.add_argument("root", help="image root dir")
+    cgroup = parser.add_argument_group("list creation")
+    cgroup.add_argument("--list", action="store_true",
+                        help="make a list file instead of a record file")
+    cgroup.add_argument("--exts", nargs="+",
+                        default=[".jpeg", ".jpg", ".png"])
+    cgroup.add_argument("--chunks", type=int, default=1)
+    cgroup.add_argument("--train-ratio", type=float, default=1.0)
+    cgroup.add_argument("--test-ratio", type=float, default=0)
+    cgroup.add_argument("--recursive", action="store_true")
+    cgroup.add_argument("--shuffle", type=bool, default=True)
+    rgroup = parser.add_argument_group("record packing")
+    rgroup.add_argument("--pass-through", action="store_true",
+                        help="skip decode/re-encode, copy raw bytes")
+    rgroup.add_argument("--resize", type=int, default=0)
+    rgroup.add_argument("--center-crop", action="store_true")
+    rgroup.add_argument("--quality", type=int, default=95)
+    rgroup.add_argument("--num-thread", type=int, default=1)
+    rgroup.add_argument("--encoding", choices=[".jpg", ".png"],
+                        default=".jpg")
+    return parser.parse_args()
+
+
+def main():
+    args = parse_args()
+    if args.list:
+        make_list(args)
+        return
+    files = [args.prefix + ".lst"] if os.path.isfile(args.prefix + ".lst") \
+        else [os.path.join(os.path.dirname(args.prefix) or ".", f)
+              for f in sorted(os.listdir(os.path.dirname(args.prefix) or "."))
+              if f.startswith(os.path.basename(args.prefix)) and
+              f.endswith(".lst")]
+    if not files:
+        raise FileNotFoundError("no .lst file for prefix %s (run --list "
+                                "first)" % args.prefix)
+    for f in files:
+        print("Creating .rec file from", f)
+        im2rec(args, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Kill stray mxnet_tpu worker processes on this host (and, with a host
+file, over ssh) — the reference's ``tools/kill-mxnet.py`` cleanup after a
+crashed distributed run.
+"""
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+_PATTERN = "MXTPU_PROCESS_ID"
+
+
+def local_pids(pattern):
+    out = subprocess.run(["ps", "axww", "-o", "pid=,command="],
+                         capture_output=True, text=True).stdout
+    me = os.getpid()
+    pids = []
+    for line in out.splitlines():
+        try:
+            pid, cmd = line.strip().split(None, 1)
+        except ValueError:
+            continue
+        if pattern in cmd and int(pid) != me and "kill_mxtpu" not in cmd:
+            pids.append(int(pid))
+    # also match by env (the launcher tags every worker with
+    # MXTPU_PROCESS_ID); /proc is linux-only, best-effort
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit() or int(pid) == me:
+            continue
+        try:
+            with open("/proc/%s/environ" % pid, "rb") as f:
+                if _PATTERN.encode() in f.read():
+                    pids.append(int(pid))
+        except OSError:
+            continue
+    return sorted(set(pids))
+
+
+def main():
+    parser = argparse.ArgumentParser(description="kill mxnet_tpu jobs")
+    parser.add_argument("--pattern", default="mxnet_tpu",
+                        help="substring of the command line to match")
+    parser.add_argument("-H", "--host-file", default=None,
+                        help="also clean these hosts over ssh")
+    args = parser.parse_args()
+    pids = local_pids(args.pattern)
+    for pid in pids:
+        print("killing %d" % pid)
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError as e:
+            print("  %s" % e, file=sys.stderr)
+    if args.host_file:
+        with open(args.host_file) as f:
+            hosts = [h.strip() for h in f if h.strip() and
+                     not h.startswith("#")]
+        for host in hosts:
+            subprocess.call(
+                ["ssh", "-o", "StrictHostKeyChecking=no", host,
+                 "pkill -9 -f %s || true" % args.pattern])
+
+
+if __name__ == "__main__":
+    main()

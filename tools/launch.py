@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Multi-host job launcher.
+
+The reference launches PS-architecture jobs (scheduler + servers + workers)
+through dmlc-core trackers (``tools/launch.py:13-50``, ssh/mpi/sge/yarn/
+local).  On TPU there are no server processes: every host runs the same
+SPMD program and gradients ride ICI/DCN collectives, so the launcher's job
+shrinks to starting one identical process per host with the
+``jax.distributed`` coordination env:
+
+* ``MXTPU_COORDINATOR``  — ``host:port`` of process 0
+* ``MXTPU_NUM_PROCESSES``
+* ``MXTPU_PROCESS_ID``
+
+(read by ``mxnet_tpu.kvstore.create('dist_sync_tpu')`` →
+``jax.distributed.initialize``).
+
+Launch modes:
+
+* ``local``  — fork N processes on this machine (the reference's
+  dmlc local tracker trick used by ``tests/nightly/dist_sync_kvstore.py``);
+  each gets ``JAX_PLATFORMS=cpu`` and a private ``XLA_FLAGS`` virtual-device
+  count so collectives are exercised without a pod.
+* ``ssh``    — one process per line of ``--host-file``, same binary+args,
+  envs injected over ssh (reference ssh tracker analog).
+* ``gcloud`` — print (or run) the ``gcloud compute tpus tpu-vm ssh --worker=all``
+  command that starts the program on every worker of a TPU pod slice, where
+  JAX discovers the topology natively and no env injection is needed.
+"""
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def launch_local(args):
+    port = _free_port()
+    coordinator = "127.0.0.1:%d" % port
+    procs = []
+    for rank in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "MXTPU_COORDINATOR": coordinator,
+            "MXTPU_NUM_PROCESSES": str(args.num_workers),
+            "MXTPU_PROCESS_ID": str(rank),
+            # local mode runs on host CPU devices
+            "JAX_PLATFORMS": "cpu",
+            "TPU_SKIP_MDS_QUERY": "1",
+        })
+        if args.devices_per_worker:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                                " --xla_force_host_platform_device_count=%d"
+                                % args.devices_per_worker)
+        procs.append(subprocess.Popen(args.command, env=env))
+    code = 0
+
+    def _kill_all(signum=None, frame=None):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+    signal.signal(signal.SIGINT, _kill_all)
+    signal.signal(signal.SIGTERM, _kill_all)
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    if code:
+        _kill_all()
+    return code
+
+
+def launch_ssh(args):
+    with open(args.host_file) as f:
+        hosts = [h.strip() for h in f if h.strip() and
+                 not h.startswith("#")]
+    coordinator = "%s:%d" % (hosts[0].split()[0], args.port)
+    procs = []
+    for rank, host in enumerate(hosts):
+        envs = ("MXTPU_COORDINATOR=%s MXTPU_NUM_PROCESSES=%d "
+                "MXTPU_PROCESS_ID=%d" % (coordinator, len(hosts), rank))
+        cmd = ["ssh", "-o", "StrictHostKeyChecking=no", host,
+               "cd %s; %s %s" % (args.remote_dir or "~", envs,
+                                 " ".join(args.command))]
+        procs.append(subprocess.Popen(cmd))
+    code = 0
+    for p in procs:
+        p.wait()
+        code = code or p.returncode
+    return code
+
+
+def launch_gcloud(args):
+    cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+           "--zone", args.zone, "--worker=all",
+           "--command", " ".join(args.command)]
+    print(" ".join(cmd))
+    if args.dry_run:
+        return 0
+    return subprocess.call(cmd)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="Launch a distributed mxnet_tpu job")
+    parser.add_argument("-n", "--num-workers", type=int, default=1,
+                        help="number of processes (local mode)")
+    parser.add_argument("--launcher", choices=["local", "ssh", "gcloud"],
+                        default="local")
+    parser.add_argument("--devices-per-worker", type=int, default=0,
+                        help="local mode: virtual CPU devices per process")
+    parser.add_argument("-H", "--host-file", default=None,
+                        help="ssh mode: one host per line")
+    parser.add_argument("--port", type=int, default=9000,
+                        help="ssh mode: coordinator port on host[0]")
+    parser.add_argument("--remote-dir", default=None)
+    parser.add_argument("--tpu-name", default=None, help="gcloud mode")
+    parser.add_argument("--zone", default="us-central1-a")
+    parser.add_argument("--dry-run", action="store_true")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="program and args to run on every worker")
+    args = parser.parse_args()
+    if not args.command:
+        parser.error("no command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    if args.launcher == "local":
+        sys.exit(launch_local(args))
+    elif args.launcher == "ssh":
+        if not args.host_file:
+            parser.error("--host-file required for ssh launcher")
+        sys.exit(launch_ssh(args))
+    else:
+        if not args.tpu_name:
+            parser.error("--tpu-name required for gcloud launcher")
+        sys.exit(launch_gcloud(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Parse training logs into a per-epoch table (reference
+``tools/parse_log.py``): extracts train/validation metric values and
+Speedometer throughput, prints TSV.
+
+Works on logs produced by ``mxnet_tpu.callback.Speedometer`` +
+``module.fit``'s epoch summaries, which use the reference's format:
+
+    Epoch[0] Batch [20]  Speed: 12345.67 samples/sec  accuracy=0.123456
+    Epoch[0] Train-accuracy=0.94
+    Epoch[0] Time cost=1.23
+    Epoch[0] Validation-accuracy=0.95
+"""
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric="accuracy"):
+    rows = {}
+
+    def row(epoch):
+        return rows.setdefault(epoch, {"train": None, "val": None,
+                                       "speed": [], "time": None})
+
+    re_speed = re.compile(
+        r"Epoch\[(\d+)\] Batch \[[-\d]+\]\s+Speed: ([\d.]+) samples/sec")
+    re_train = re.compile(
+        r"Epoch\[(\d+)\] Train-%s=([\d.eE+-]+)" % re.escape(metric))
+    re_val = re.compile(
+        r"Epoch\[(\d+)\] Validation-%s=([\d.eE+-]+)" % re.escape(metric))
+    re_time = re.compile(r"Epoch\[(\d+)\] Time cost=([\d.eE+-]+)")
+    for line in lines:
+        m = re_speed.search(line)
+        if m:
+            row(int(m.group(1)))["speed"].append(float(m.group(2)))
+        m = re_train.search(line)
+        if m:
+            row(int(m.group(1)))["train"] = float(m.group(2))
+        m = re_val.search(line)
+        if m:
+            row(int(m.group(1)))["val"] = float(m.group(2))
+        m = re_time.search(line)
+        if m:
+            row(int(m.group(1)))["time"] = float(m.group(2))
+    return rows
+
+
+def main():
+    parser = argparse.ArgumentParser(description="parse mxnet_tpu logs")
+    parser.add_argument("logfile", nargs="?", default=None)
+    parser.add_argument("--format", choices=["markdown", "none"],
+                        default="markdown")
+    parser.add_argument("--metric", default="accuracy")
+    args = parser.parse_args()
+    lines = open(args.logfile).readlines() if args.logfile \
+        else sys.stdin.readlines()
+    rows = parse(lines, args.metric)
+    sep = " | " if args.format == "markdown" else "\t"
+    head = sep.join(["epoch", "train-" + args.metric,
+                     "val-" + args.metric, "speed", "time-cost"])
+    if args.format == "markdown":
+        head = "| " + head + " |"
+        print(head)
+        print("| --- " * 5 + "|")
+    else:
+        print(head)
+    for epoch in sorted(rows):
+        r = rows[epoch]
+        speed = sum(r["speed"]) / len(r["speed"]) if r["speed"] else 0.0
+        cells = [str(epoch),
+                 "%.6f" % r["train"] if r["train"] is not None else "-",
+                 "%.6f" % r["val"] if r["val"] is not None else "-",
+                 "%.2f" % speed,
+                 "%.2f" % r["time"] if r["time"] is not None else "-"]
+        line = sep.join(cells)
+        if args.format == "markdown":
+            line = "| " + line + " |"
+        print(line)
+
+
+if __name__ == "__main__":
+    main()
