@@ -21,18 +21,26 @@ from common import fit
 
 
 def get_mnist_iter(args, kv):
-    image = os.path.join(args.data_dir, "train-images-idx3-ubyte")
-    label = os.path.join(args.data_dir, "train-labels-idx1-ubyte")
+    def _find(name):
+        path = os.path.join(args.data_dir, name)
+        if os.path.exists(path):
+            return path
+        if os.path.exists(path + ".gz"):
+            return path + ".gz"     # MNISTIter gunzips *.gz paths
+        return None
+
+    image = _find("train-images-idx3-ubyte")
+    label = _find("train-labels-idx1-ubyte")
     flat = args.network == "mlp"
-    if os.path.exists(image) or os.path.exists(image + ".gz"):
+    if image and label:
         train = mx.io.MNISTIter(image=image, label=label,
                                 batch_size=args.batch_size, shuffle=True,
                                 flat=flat,
                                 num_parts=kv.num_workers,
                                 part_index=kv.rank)
         val = mx.io.MNISTIter(
-            image=os.path.join(args.data_dir, "t10k-images-idx3-ubyte"),
-            label=os.path.join(args.data_dir, "t10k-labels-idx1-ubyte"),
+            image=_find("t10k-images-idx3-ubyte"),
+            label=_find("t10k-labels-idx1-ubyte"),
             batch_size=args.batch_size, flat=flat)
         return train, val
     logging.warning("MNIST files not found under %s; using synthetic data",

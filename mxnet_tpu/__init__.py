@@ -83,6 +83,7 @@ from . import model
 from .model import FeedForward
 from .executor_manager import DataParallelExecutorGroup  # noqa: F401
 from . import profiler
+from . import rtc
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import parallel

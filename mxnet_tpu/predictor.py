@@ -1,0 +1,144 @@
+"""Deploy-only predictor — the analog of the reference's predict-only C API
+(``include/mxnet/c_predict_api.h``, ``src/c_api/c_predict_api.cc``): load a
+saved symbol + params, bind forward-only, feed inputs, fetch outputs.  No
+optimizer, no autograd, one jitted forward per input shape.
+
+The same object backs the native C ABI in ``native/mxtpu_c_api.cc``
+(MXPredCreate/SetInput/Forward/GetOutput), so C/C++ deployments link one
+shared library exactly like the reference's amalgamated predict build.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import struct
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .base import MXNetError
+from . import ndarray as nd
+from . import symbol as sym
+
+__all__ = ["Predictor"]
+
+
+def _load_params_bytes(blob: bytes):
+    """Parse a ``prefix-NNNN.params`` blob (NDArray.Save format,
+    reference ``c_predict_api.cc:87-117``)."""
+    import tempfile
+    import os
+    # nd.load reads from a path; parse the same container from memory
+    fd, path = tempfile.mkstemp(suffix=".params")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(blob)
+        save_dict = nd.load(path)
+    finally:
+        os.unlink(path)
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:                       # unprefixed = arg (reference behavior)
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+class Predictor(object):
+    """Forward-only executor over a saved model.
+
+    Parameters
+    ----------
+    symbol_json : str
+        the ``*-symbol.json`` content.
+    param_bytes : bytes
+        the ``*.params`` file content.
+    input_shapes : dict name -> shape
+        every data input's shape (batch included).
+    dev_type/dev_id : str/int
+        kept for C-API signature parity; TPU placement is automatic.
+    """
+
+    def __init__(self, symbol_json: str, param_bytes: bytes,
+                 input_shapes: Dict[str, Sequence[int]],
+                 dev_type: str = "tpu", dev_id: int = 0):
+        self.symbol = sym.load_json(symbol_json)
+        arg_params, aux_params = _load_params_bytes(param_bytes)
+        self.input_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+
+        arg_names = self.symbol.list_arguments()
+        aux_names = self.symbol.list_auxiliary_states()
+        arg_shapes, out_shapes, aux_shapes = \
+            self.symbol.infer_shape(**self.input_shapes)
+        self._out_shapes = [tuple(s) for s in out_shapes]
+
+        self._args = {}
+        for name, shape in zip(arg_names, arg_shapes):
+            if name in self.input_shapes:
+                self._args[name] = nd.zeros(shape)
+            elif name in arg_params:
+                if tuple(arg_params[name].shape) != tuple(shape):
+                    raise MXNetError(
+                        "param %s shape %s != expected %s"
+                        % (name, arg_params[name].shape, tuple(shape)))
+                self._args[name] = arg_params[name]
+            elif name.endswith("label"):
+                # unused loss-layer label input: zeros
+                self._args[name] = nd.zeros(shape)
+            else:
+                raise MXNetError(
+                    "parameter %s missing from the params blob" % name)
+        self._auxs = {}
+        for name, shape in zip(aux_names, aux_shapes):
+            if name not in aux_params:
+                self._auxs[name] = nd.zeros(shape)
+            else:
+                self._auxs[name] = aux_params[name]
+
+        self._executor = self.symbol.bind(
+            args=self._args, args_grad=None, grad_req="null",
+            aux_states=self._auxs)
+        self._outputs: Optional[List] = None
+
+    @classmethod
+    def from_checkpoint(cls, prefix: str, epoch: int,
+                        input_shapes: Dict[str, Sequence[int]]):
+        with open("%s-symbol.json" % prefix) as f:
+            symbol_json = f.read()
+        with open("%s-%04d.params" % (prefix, epoch), "rb") as f:
+            param_bytes = f.read()
+        return cls(symbol_json, param_bytes, input_shapes)
+
+    # -- c_predict_api-shaped surface ---------------------------------
+    def set_input(self, name: str, value) -> None:
+        if name not in self.input_shapes:
+            raise MXNetError("%s is not a declared input" % name)
+        arr = np.asarray(value, dtype=np.float32)
+        if tuple(arr.shape) != self.input_shapes[name]:
+            raise MXNetError("input %s shape %s != declared %s"
+                             % (name, arr.shape, self.input_shapes[name]))
+        self._args[name][:] = arr
+
+    def forward(self) -> None:
+        self._outputs = self._executor.forward(is_train=False)
+
+    def get_output_shape(self, index: int):
+        return self._out_shapes[index]
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._out_shapes)
+
+    def get_output(self, index: int) -> np.ndarray:
+        if self._outputs is None:
+            raise MXNetError("call forward() first")
+        return np.asarray(self._outputs[index].asnumpy(), dtype=np.float32)
+
+    def predict(self, **inputs) -> List[np.ndarray]:
+        """Convenience: set every input, forward, return all outputs."""
+        for name, value in inputs.items():
+            self.set_input(name, value)
+        self.forward()
+        return [self.get_output(i) for i in range(self.num_outputs)]

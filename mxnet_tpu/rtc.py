@@ -1,0 +1,127 @@
+"""Runtime-compiled kernels.
+
+The reference's ``mx.rtc`` (``python/mxnet/rtc.py``, ``src/common/mxrtc.cc``,
+``include/mxnet/mxrtc.h:26-81``) compiles CUDA C source through NVRTC at
+runtime and launches it on NDArrays.  The TPU-native analog compiles a
+**Python source string** into a jitted XLA computation — or a Pallas TPU
+kernel — at runtime.  Same shape of API: named inputs, named outputs, a
+kernel body, then ``push(ins, outs, grid, block)`` to run it on NDArrays.
+
+The kernel body is ordinary jax.numpy code (or a Pallas kernel body using
+``_ref`` suffixed names) with the input/output names bound::
+
+    rtc = mx.rtc.Rtc('axpy', [('x', x), ('alpha_', a)], [('y', y)],
+                     "y = alpha_ * x + 1")
+    rtc.push([x, a], [y])                 # grid/block are ignored by XLA
+
+    pk = mx.rtc.Rtc('scale', [('x', x)], [('y', y)],
+                    "y_ref[...] = x_ref[...] * 2.0", language='pallas')
+    pk.push([x], [y])
+
+Security note: like the reference (which compiled and ran arbitrary CUDA
+source), this executes the given source in-process; only feed it trusted
+strings.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["Rtc"]
+
+
+class Rtc(object):
+    """A runtime-compiled kernel (reference ``rtc.py:10-91``).
+
+    Parameters
+    ----------
+    name : str
+        kernel name (used in error messages / profiler).
+    inputs, outputs : list of (name, NDArray)
+        names bound in the kernel source; the NDArrays supply
+        shape/dtype prototypes for compilation.
+    kernel : str
+        Python source.  ``language='jax'``: statements reading input
+        names and assigning every output name with jax.numpy
+        expressions.  ``language='pallas'``: a Pallas kernel body where
+        each name is available as ``<name>_ref``.
+    """
+
+    def __init__(self, name, inputs, outputs, kernel, language="jax"):
+        self.name = name
+        self.input_names = [n for n, _ in inputs]
+        self.output_names = [n for n, _ in outputs]
+        self._out_protos = [(tuple(a.shape), a.dtype) for _, a in outputs]
+        self.kernel = kernel
+        self.language = language
+        if language == "jax":
+            self._fn = self._compile_jax(kernel)
+        elif language == "pallas":
+            self._fn = self._compile_pallas(kernel)
+        else:
+            raise MXNetError("unknown rtc language %s" % language)
+
+    # -- compilation ---------------------------------------------------
+    def _compile_jax(self, src):
+        code = compile(src, "<rtc:%s>" % self.name, "exec")
+
+        def body(*args):
+            env = {"jnp": jnp, "jax": jax, "lax": lax, "np": jnp}
+            env.update(zip(self.input_names, args))
+            exec(code, env)
+            missing = [n for n in self.output_names if n not in env]
+            if missing:
+                raise MXNetError("rtc kernel %s did not assign outputs %s"
+                                 % (self.name, missing))
+            return tuple(env[n] for n in self.output_names)
+
+        return jax.jit(body)
+
+    def _compile_pallas(self, src):
+        from jax.experimental import pallas as pl
+
+        code = compile(src, "<rtc:%s>" % self.name, "exec")
+        ref_names = [n + "_ref" for n in
+                     self.input_names + self.output_names]
+
+        def kernel(*refs):
+            env = {"jnp": jnp, "jax": jax, "lax": lax, "pl": pl}
+            env.update(zip(ref_names, refs))
+            exec(code, env)
+
+        out_shape = [jax.ShapeDtypeStruct(s, d) for s, d in self._out_protos]
+        if len(out_shape) == 1:
+            out_shape = out_shape[0]
+
+        # compiled Mosaic on TPU; bit-accurate interpreter elsewhere
+        interpret = jax.default_backend() != "tpu"
+
+        def call(*args):
+            return pl.pallas_call(kernel, out_shape=out_shape,
+                                  interpret=interpret)(*args)
+
+        return jax.jit(call)
+
+    # -- execution -----------------------------------------------------
+    def push(self, ins, outs, grid_dims=None, block_dims=None):
+        """Run the kernel.  ``grid_dims``/``block_dims`` exist for API
+        compatibility; XLA/Mosaic choose the schedule."""
+        if len(ins) != len(self.input_names) or \
+                len(outs) != len(self.output_names):
+            raise MXNetError("rtc %s: expected %d inputs / %d outputs"
+                             % (self.name, len(self.input_names),
+                                len(self.output_names)))
+        args = [a.data if isinstance(a, NDArray) else jnp.asarray(a)
+                for a in ins]
+        results = self._fn(*args)
+        if not isinstance(results, (tuple, list)):
+            results = (results,)
+        for out, val in zip(outs, results):
+            out._set_data(val.astype(out.dtype))
+        return outs
+
+    __call__ = push

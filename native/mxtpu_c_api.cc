@@ -1,0 +1,235 @@
+// mxtpu C predict API: the deploy-only flat C ABI of the reference
+// (include/mxnet/c_predict_api.h, src/c_api/c_predict_api.cc) for the
+// TPU-native framework.
+//
+// The reference's predict API is a thin C shim over its native executor.
+// Here the executor substrate is XLA driven from Python, so the shim
+// embeds CPython: each PredictorHandle owns an mxnet_tpu.predictor
+// .Predictor instance; every call round-trips through the GIL.  Loaded
+// from a C/C++ program it initializes the interpreter itself; loaded
+// inside a Python process (ctypes) it just takes the GIL.
+//
+// ABI (signature-compatible with c_predict_api.h:40-210):
+//   MXGetLastError
+//   MXPredCreate            (json, param blob, dev, named input shapes)
+//   MXPredGetOutputShape
+//   MXPredSetInput          (float32 payload)
+//   MXPredForward
+//   MXPredGetOutput
+//   MXPredFree
+//
+// Build: native/Makefile -> mxnet_tpu/lib/libmxtpu_c_api.so
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct PredictorRec {
+  PyObject *obj;                       // mxnet_tpu.predictor.Predictor
+  std::vector<std::vector<mx_uint>> out_shapes;  // filled lazily
+};
+
+// Interpreter bootstrap: if the host program is not Python, start one.
+void EnsurePython() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so that
+      // PyGILState_Ensure below works from any thread
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class Gil {
+ public:
+  Gil() { state_ = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+int Fail(const char *where) {
+  Gil gil;
+  std::string msg = where;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    if (value != nullptr) {
+      PyObject *s = PyObject_Str(value);
+      if (s != nullptr) {
+        msg += ": ";
+        msg += PyUnicode_AsUTF8(s);
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+  g_last_error = msg;
+  return -1;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *MXGetLastError() { return g_last_error.c_str(); }
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  (void)dev_type;
+  (void)dev_id;
+  EnsurePython();
+  Gil gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.predictor");
+  if (mod == nullptr) return Fail("import mxnet_tpu.predictor");
+  PyObject *cls = PyObject_GetAttrString(mod, "Predictor");
+  Py_DECREF(mod);
+  if (cls == nullptr) return Fail("Predictor class");
+
+  PyObject *shapes = PyDict_New();
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    mx_uint lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+    PyObject *tup = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(tup, j - lo, PyLong_FromUnsignedLong(
+                                        input_shape_data[j]));
+    PyDict_SetItemString(shapes, input_keys[i], tup);
+    Py_DECREF(tup);
+  }
+  PyObject *blob =
+      PyBytes_FromStringAndSize(static_cast<const char *>(param_bytes),
+                                param_size);
+  PyObject *obj = PyObject_CallFunction(cls, "sOO", symbol_json_str, blob,
+                                        shapes);
+  Py_DECREF(cls);
+  Py_DECREF(blob);
+  Py_DECREF(shapes);
+  if (obj == nullptr) return Fail("MXPredCreate");
+  auto *rec = new PredictorRec{obj, {}};
+  *out = rec;
+  return 0;
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  auto *rec = static_cast<PredictorRec *>(handle);
+  Gil gil;
+  PyObject *shape = PyObject_CallMethod(rec->obj, "get_output_shape", "I",
+                                        index);
+  if (shape == nullptr) return Fail("MXPredGetOutputShape");
+  Py_ssize_t n = PySequence_Size(shape);
+  std::vector<mx_uint> dims(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *d = PySequence_GetItem(shape, i);
+    dims[i] = static_cast<mx_uint>(PyLong_AsUnsignedLong(d));
+    Py_DECREF(d);
+  }
+  Py_DECREF(shape);
+  if (rec->out_shapes.size() <= index) rec->out_shapes.resize(index + 1);
+  rec->out_shapes[index] = std::move(dims);
+  *shape_data = rec->out_shapes[index].data();
+  *shape_ndim = static_cast<mx_uint>(rec->out_shapes[index].size());
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  auto *rec = static_cast<PredictorRec *>(handle);
+  Gil gil;
+  // shape comes from the predictor's declared input shape
+  PyObject *shapes = PyObject_GetAttrString(rec->obj, "input_shapes");
+  if (shapes == nullptr) return Fail("MXPredSetInput");
+  PyObject *shape = PyDict_GetItemString(shapes, key);  // borrowed
+  if (shape == nullptr) {
+    Py_DECREF(shapes);
+    g_last_error = std::string("unknown input ") + key;
+    return -1;
+  }
+  PyObject *np = PyImport_ImportModule("numpy");
+  PyObject *flat = nullptr, *arr = nullptr, *res = nullptr;
+  int ret = -1;
+  do {
+    if (np == nullptr) break;
+    PyObject *bytes = PyBytes_FromStringAndSize(
+        reinterpret_cast<const char *>(data), size * sizeof(mx_float));
+    if (bytes == nullptr) break;
+    flat = PyObject_CallMethod(np, "frombuffer", "Os", bytes, "float32");
+    Py_DECREF(bytes);
+    if (flat == nullptr) break;
+    arr = PyObject_CallMethod(flat, "reshape", "O", shape);
+    if (arr == nullptr) break;
+    res = PyObject_CallMethod(rec->obj, "set_input", "sO", key, arr);
+    if (res == nullptr) break;
+    ret = 0;
+  } while (false);
+  Py_XDECREF(res);
+  Py_XDECREF(arr);
+  Py_XDECREF(flat);
+  Py_XDECREF(np);
+  Py_DECREF(shapes);
+  return ret == 0 ? 0 : Fail("MXPredSetInput");
+}
+
+int MXPredForward(PredictorHandle handle) {
+  auto *rec = static_cast<PredictorRec *>(handle);
+  Gil gil;
+  PyObject *res = PyObject_CallMethod(rec->obj, "forward", nullptr);
+  if (res == nullptr) return Fail("MXPredForward");
+  Py_DECREF(res);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  auto *rec = static_cast<PredictorRec *>(handle);
+  Gil gil;
+  PyObject *out = PyObject_CallMethod(rec->obj, "get_output", "I", index);
+  if (out == nullptr) return Fail("MXPredGetOutput");
+  PyObject *bytes = PyObject_CallMethod(out, "tobytes", nullptr);
+  Py_DECREF(out);
+  if (bytes == nullptr) return Fail("MXPredGetOutput tobytes");
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &len);
+  if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
+    Py_DECREF(bytes);
+    g_last_error = "MXPredGetOutput: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  auto *rec = static_cast<PredictorRec *>(handle);
+  {
+    Gil gil;
+    Py_XDECREF(rec->obj);
+  }
+  delete rec;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,53 @@
+// Pure-C smoke test for the embedded-python predict API.
+// Build: make test_c_predict   Run: PYTHONPATH=<repo> ./test_c_predict <model-prefix>
+// The model prefix must point at a 2x8-input, 5-class checkpoint like the
+// one tests/test_c_api.py saves.
+#include <stdio.h>
+#include <stdlib.h>
+typedef unsigned int mx_uint;
+extern const char *MXGetLastError();
+extern int MXPredCreate(const char*, const void*, int, int, int, mx_uint,
+                        const char**, const mx_uint*, const mx_uint*, void**);
+extern int MXPredSetInput(void*, const char*, const float*, mx_uint);
+extern int MXPredForward(void*);
+extern int MXPredGetOutput(void*, mx_uint, float*, mx_uint);
+extern int MXPredFree(void*);
+
+static const char *model_prefix;
+
+static char *slurp(const char *path, long *len) {
+  FILE *f = fopen(path, "rb");
+  if (!f) { perror(path); exit(1); }
+  fseek(f, 0, SEEK_END); *len = ftell(f); fseek(f, 0, SEEK_SET);
+  char *buf = malloc(*len + 1);
+  fread(buf, 1, *len, f); buf[*len] = 0; fclose(f);
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  model_prefix = argc > 1 ? argv[1] : "/tmp/cpred/m";
+  long jlen, plen;
+  char path[512];
+  snprintf(path, sizeof path, "%s-symbol.json", model_prefix);
+  char *json = slurp(path, &jlen);
+  snprintf(path, sizeof path, "%s-0003.params", model_prefix);
+  char *params = slurp(path, &plen);
+  const char *keys[] = {"data"};
+  mx_uint indptr[] = {0, 2}, shp[] = {2, 8};
+  void *h = NULL;
+  if (MXPredCreate(json, params, (int)plen, 1, 0, 1, keys, indptr, shp, &h)) {
+    fprintf(stderr, "create failed: %s\n", MXGetLastError()); return 1;
+  }
+  float x[16]; for (int i = 0; i < 16; ++i) x[i] = (float)i / 16.0f - 0.5f;
+  if (MXPredSetInput(h, "data", x, 16) || MXPredForward(h)) {
+    fprintf(stderr, "fwd failed: %s\n", MXGetLastError()); return 1;
+  }
+  float out[10];
+  if (MXPredGetOutput(h, 0, out, 10)) {
+    fprintf(stderr, "get failed: %s\n", MXGetLastError()); return 1;
+  }
+  float s = 0; for (int i = 0; i < 5; ++i) s += out[i];
+  printf("row0 softmax sum = %.5f\n", s);
+  MXPredFree(h);
+  return (s > 0.99f && s < 1.01f) ? 0 : 2;
+}

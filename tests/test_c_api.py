@@ -1,0 +1,96 @@
+"""Native C predict API (the reference's ``c_predict_api.h`` surface,
+built as ``libmxtpu_c_api.so``) driven via ctypes, plus the python
+Predictor it wraps."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.predictor import Predictor
+
+_LIB = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "mxnet_tpu", "lib", "libmxtpu_c_api.so")
+
+
+def _make_checkpoint(tmp_path):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=5,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc_weight": mx.nd.array(rng.normal(0, 1, (5, 8)).astype("f")),
+            "fc_bias": mx.nd.array(rng.normal(0, 1, (5,)).astype("f"))}
+    prefix = str(tmp_path / "m")
+    mx.model.save_checkpoint(prefix, 3, net, args, {})
+    return prefix, rng
+
+
+def test_python_predictor(tmp_path):
+    prefix, rng = _make_checkpoint(tmp_path)
+    p = Predictor.from_checkpoint(prefix, 3, {"data": (2, 8)})
+    x = rng.normal(0, 1, (2, 8)).astype("f")
+    out = p.predict(data=x)[0]
+    assert out.shape == (2, 5)
+    np.testing.assert_allclose(out.sum(axis=1), [1.0, 1.0], rtol=1e-5)
+    # deterministic across calls
+    out2 = p.predict(data=x)[0]
+    np.testing.assert_allclose(out, out2)
+
+
+def test_predictor_rejects_bad_input(tmp_path):
+    prefix, rng = _make_checkpoint(tmp_path)
+    p = Predictor.from_checkpoint(prefix, 3, {"data": (2, 8)})
+    with pytest.raises(Exception):
+        p.set_input("data", np.zeros((3, 8), "f"))
+    with pytest.raises(Exception):
+        p.set_input("nope", np.zeros((2, 8), "f"))
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_predict_api(tmp_path):
+    prefix, rng = _make_checkpoint(tmp_path)
+    with open(prefix + "-symbol.json") as f:
+        sym_json = f.read().encode()
+    with open(prefix + "-0003.params", "rb") as f:
+        params = f.read()
+
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    handle = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(2, 8)
+    rc = lib.MXPredCreate(ctypes.c_char_p(sym_json), params, len(params),
+                          1, 0, 1, keys, indptr, shape_data,
+                          ctypes.byref(handle))
+    assert rc == 0, lib.MXGetLastError()
+
+    sd = ctypes.POINTER(ctypes.c_uint)()
+    ndim = ctypes.c_uint()
+    rc = lib.MXPredGetOutputShape(handle, 0, ctypes.byref(sd),
+                                  ctypes.byref(ndim))
+    assert rc == 0, lib.MXGetLastError()
+    out_shape = tuple(sd[i] for i in range(ndim.value))
+    assert out_shape == (2, 5)
+
+    x = rng.normal(0, 1, (2, 8)).astype("f")
+    rc = lib.MXPredSetInput(handle, b"data",
+                            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                            x.size)
+    assert rc == 0, lib.MXGetLastError()
+    rc = lib.MXPredForward(handle)
+    assert rc == 0, lib.MXGetLastError()
+
+    out = np.zeros((2, 5), "f")
+    rc = lib.MXPredGetOutput(
+        handle, 0, out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.size)
+    assert rc == 0, lib.MXGetLastError()
+
+    expect = Predictor.from_checkpoint(prefix, 3,
+                                       {"data": (2, 8)}).predict(data=x)[0]
+    np.testing.assert_allclose(out, expect, rtol=1e-5)
+    assert lib.MXPredFree(handle) == 0

@@ -1,0 +1,44 @@
+"""Runtime-compiled kernels (the NVRTC analog, reference
+``python/mxnet/rtc.py`` + ``src/common/mxrtc.cc``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_rtc_jax_kernel():
+    x = mx.nd.array(np.arange(12, dtype="f").reshape(3, 4))
+    a = mx.nd.array(np.array(2.0, dtype="f"))
+    y = mx.nd.zeros((3, 4))
+    rtc = mx.rtc.Rtc("axpy", [("x", x), ("alpha_", a)], [("y", y)],
+                     "y = alpha_ * x + 1")
+    rtc.push([x, a], [y])
+    np.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy() + 1)
+
+
+def test_rtc_multi_output():
+    x = mx.nd.array(np.arange(6, dtype="f"))
+    s = mx.nd.zeros((6,))
+    c = mx.nd.zeros((6,))
+    rtc = mx.rtc.Rtc("sincos", [("x", x)], [("s", s), ("c", c)],
+                     "s = jnp.sin(x)\nc = jnp.cos(x)")
+    rtc.push([x], [s, c])
+    np.testing.assert_allclose(s.asnumpy(), np.sin(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(c.asnumpy(), np.cos(x.asnumpy()), rtol=1e-6)
+
+
+def test_rtc_missing_output_raises():
+    x = mx.nd.ones((2,))
+    y = mx.nd.zeros((2,))
+    rtc = mx.rtc.Rtc("bad", [("x", x)], [("y", y)], "z = x * 2")
+    with pytest.raises(Exception):
+        rtc.push([x], [y])
+
+
+def test_rtc_pallas_kernel():
+    x = mx.nd.array(np.arange(64, dtype="f").reshape(8, 8))
+    y = mx.nd.zeros((8, 8))
+    rtc = mx.rtc.Rtc("scale2", [("x", x)], [("y", y)],
+                     "y_ref[...] = x_ref[...] * 2.0", language="pallas")
+    rtc.push([x], [y])
+    np.testing.assert_allclose(y.asnumpy(), 2 * x.asnumpy())

@@ -94,7 +94,7 @@ def read_list(path_in):
             line = [i.strip() for i in line.strip().split("\t")]
             if len(line) < 3:
                 continue
-            yield (int(line[0]),) + tuple(line[2:]) + \
+            yield (int(line[0]), line[-1]) + \
                 tuple(float(i) for i in line[1:-1])
 
 
@@ -179,7 +179,9 @@ def parse_args():
     cgroup.add_argument("--train-ratio", type=float, default=1.0)
     cgroup.add_argument("--test-ratio", type=float, default=0)
     cgroup.add_argument("--recursive", action="store_true")
-    cgroup.add_argument("--shuffle", type=bool, default=True)
+    cgroup.add_argument("--shuffle", type=lambda v: v.lower() in
+                        ("1", "true", "yes"), default=True,
+                        help="shuffle the list (true/false)")
     rgroup = parser.add_argument_group("record packing")
     rgroup.add_argument("--pass-through", action="store_true",
                         help="skip decode/re-encode, copy raw bytes")

@@ -71,11 +71,20 @@ def launch_local(args):
 
     signal.signal(signal.SIGINT, _kill_all)
     signal.signal(signal.SIGTERM, _kill_all)
-    for p in procs:
-        p.wait()
-        code = code or p.returncode
-    if code:
-        _kill_all()
+    # poll all workers: one crashing must tear the job down immediately
+    # (survivors block in jax.distributed.initialize waiting for peers)
+    import time as _time
+    live = list(procs)
+    while live:
+        for p in list(live):
+            rc = p.poll()
+            if rc is None:
+                continue
+            live.remove(p)
+            if rc != 0:
+                code = code or rc
+                _kill_all()
+        _time.sleep(0.1)
     return code
 
 
