@@ -31,17 +31,17 @@ def get_mnist_iter(args, kv):
 
     image = _find("train-images-idx3-ubyte")
     label = _find("train-labels-idx1-ubyte")
+    val_image = _find("t10k-images-idx3-ubyte")
+    val_label = _find("t10k-labels-idx1-ubyte")
     flat = args.network == "mlp"
-    if image and label:
+    if image and label and val_image and val_label:
         train = mx.io.MNISTIter(image=image, label=label,
                                 batch_size=args.batch_size, shuffle=True,
                                 flat=flat,
                                 num_parts=kv.num_workers,
                                 part_index=kv.rank)
-        val = mx.io.MNISTIter(
-            image=_find("t10k-images-idx3-ubyte"),
-            label=_find("t10k-labels-idx1-ubyte"),
-            batch_size=args.batch_size, flat=flat)
+        val = mx.io.MNISTIter(image=val_image, label=val_label,
+                              batch_size=args.batch_size, flat=flat)
         return train, val
     logging.warning("MNIST files not found under %s; using synthetic data",
                     args.data_dir)

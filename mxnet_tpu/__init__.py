@@ -8,6 +8,24 @@ User-facing surfaces mirror the reference python package
 single HLO computations, distribution is jax.sharding over a device Mesh,
 and gradient sync is an ICI all-reduce instead of a parameter server.
 """
+import os as _os
+
+if _os.environ.get("MXTPU_COORDINATOR"):
+    # join the multi-host coordination service BEFORE anything touches an
+    # XLA backend (jax.distributed.initialize must run first).  The env
+    # contract is set by tools/launch.py; on a real TPU pod slice the
+    # envs are absent and jax discovers the topology itself.
+    import jax as _jax
+    try:
+        _already = _jax._src.distributed.global_state.client is not None
+    except Exception:
+        _already = False
+    if not _already:
+        _jax.distributed.initialize(
+            coordinator_address=_os.environ["MXTPU_COORDINATOR"],
+            num_processes=int(_os.environ["MXTPU_NUM_PROCESSES"]),
+            process_id=int(_os.environ["MXTPU_PROCESS_ID"]))
+
 from . import base
 from .base import (Context, MXNetError, cpu, gpu, tpu, current_context)
 from . import name

@@ -120,7 +120,12 @@ class Context:
             if devs:
                 return devs[self.device_id % len(devs)]
             kind = "cpu"
-        devs = jax.devices("cpu") if jax.default_backend() != "cpu" else jax.devices()
+        # local_devices: in a multi-process run only this host's devices
+        # are addressable (placement on a peer's device is an error)
+        try:
+            devs = jax.local_devices(backend="cpu")
+        except RuntimeError:
+            devs = jax.local_devices()
         if kind in ("cpu", "cpu_pinned"):
             return devs[self.device_id % len(devs)]
         raise MXNetError("unknown device type %s" % kind)
@@ -138,7 +143,7 @@ def _accelerator_devices():
     try:
         backend = jax.default_backend()
         if backend != "cpu":
-            return jax.devices()
+            return jax.local_devices()
     except RuntimeError:
         pass
     return []

@@ -85,7 +85,10 @@ class KVStore(object):
             if self._updater is not None:
                 self._updater(_updater_key(k), merged, self._stored[k])
             else:
-                self._stored[k] += merged
+                # no updater: the merged push replaces the stored value
+                # (reference kvstore_local.h:69-71 `local = merged`;
+                # _merge always returns a fresh array, no copy needed)
+                self._stored[k] = merged
 
     def pull(self, key, out=None, priority=0):
         assert out is not None
@@ -186,6 +189,9 @@ class KVStoreTPU(KVStore):
     """
 
     def __init__(self, kind):
+        # the coordination service is joined at package import time from
+        # the launcher's MXTPU_* env (mxnet_tpu/__init__.py) — it must
+        # run before any XLA backend use, which is long before here
         super().__init__(kind)
         import jax
         self._jax = jax
@@ -203,6 +209,23 @@ class KVStoreTPU(KVStore):
             return self._jax.process_count()
         except Exception:
             return 1
+
+    def init(self, key, value):
+        """Rank-0's value wins (reference ``kvstore_dist.h:63-80``: only
+        rank 0 pushes the init; everyone pulls it back).  Guards against
+        host-side RNG skew: workers with different shard sizes consume
+        different amounts of shared RNG state before init runs, so
+        locally computed inits are NOT identical (SURVEY §7 hard part 4)."""
+        keys, single = _key_list(key)
+        vals = _val_list_list(value, single)
+        for k, vlist in zip(keys, vals):
+            if k in self._stored:
+                continue
+            v = vlist[0]
+            if self.num_workers > 1:
+                from .parallel.collectives import broadcast_from_rank0
+                v = NDArray(broadcast_from_rank0(v.data))
+            self._stored[k] = v.copy()
 
     def _merge(self, vlist):
         merged = super()._merge(vlist)

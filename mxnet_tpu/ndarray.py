@@ -513,16 +513,27 @@ def save(fname, data):
 def load(fname):
     """Load NDArrays saved by :func:`save` (or by the reference)."""
     with open(fname, "rb") as f:
-        magic, _ = struct.unpack("<QQ", f.read(16))
-        if magic != _MAGIC:
-            raise MXNetError("Invalid NDArray file format")
-        n, = struct.unpack("<Q", f.read(8))
-        data = [_load_one(f) for _ in range(n)]
-        k, = struct.unpack("<Q", f.read(8))
-        names = []
-        for _ in range(k):
-            ln, = struct.unpack("<Q", f.read(8))
-            names.append(f.read(ln).decode("utf-8"))
+        return _load_fileobj(f)
+
+
+def load_buffer(blob):
+    """Load NDArrays from an in-memory params blob (the C predict API's
+    load-from-bytes path, reference ``c_predict_api.cc:87-117``)."""
+    import io as _pyio
+    return _load_fileobj(_pyio.BytesIO(blob))
+
+
+def _load_fileobj(f):
+    magic, _ = struct.unpack("<QQ", f.read(16))
+    if magic != _MAGIC:
+        raise MXNetError("Invalid NDArray file format")
+    n, = struct.unpack("<Q", f.read(8))
+    data = [_load_one(f) for _ in range(n)]
+    k, = struct.unpack("<Q", f.read(8))
+    names = []
+    for _ in range(k):
+        ln, = struct.unpack("<Q", f.read(8))
+        names.append(f.read(ln).decode("utf-8"))
     if names:
         return dict(zip(names, data))
     return data

@@ -18,7 +18,22 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .mesh import get_mesh
 
-__all__ = ["global_allreduce", "barrier", "psum_over_mesh"]
+__all__ = ["global_allreduce", "barrier", "psum_over_mesh",
+           "broadcast_from_rank0"]
+
+
+def broadcast_from_rank0(value):
+    """Every process returns process 0's ``value`` (the reference's
+    rank-0-only init push + pull, ``kvstore_dist.h:63-80``)."""
+    try:
+        n_proc = jax.process_count()
+    except Exception:
+        n_proc = 1
+    if n_proc <= 1:
+        return value
+    from jax.experimental import multihost_utils
+    return jnp.asarray(
+        multihost_utils.broadcast_one_to_all(np.asarray(value)))
 
 
 def global_allreduce(value):
@@ -34,7 +49,15 @@ def global_allreduce(value):
         n_proc = 1
     if n_proc <= 1:
         return value
-    mesh = get_mesh()
+    # one device per process: each process contributes exactly one shard
+    # regardless of how many local devices it has
+    devs, seen = [], set()
+    for d in jax.devices():
+        if d.process_index not in seen:
+            seen.add(d.process_index)
+            devs.append(d)
+    from jax.sharding import Mesh
+    mesh = Mesh(np.array(devs), ("data",))
 
     def _sum(x):
         return jax.lax.psum(x, axis_name="data")
@@ -46,7 +69,10 @@ def global_allreduce(value):
     # value is host-local; make it a global sharded array first
     garr = jax.make_array_from_process_local_data(
         NamedSharding(mesh, PartitionSpec("data")), np.asarray(value))
-    return f(garr)
+    out = f(garr)
+    # the result is fully replicated: hand back this process's shard as a
+    # plain host-local array so callers can mix it with local arrays
+    return jnp.asarray(out.addressable_data(0))
 
 
 def psum_over_mesh(x, axis_name="data"):

@@ -9,8 +9,6 @@ shared library exactly like the reference's amalgamated predict build.
 """
 from __future__ import annotations
 
-import io as _pyio
-import struct
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -25,16 +23,7 @@ __all__ = ["Predictor"]
 def _load_params_bytes(blob: bytes):
     """Parse a ``prefix-NNNN.params`` blob (NDArray.Save format,
     reference ``c_predict_api.cc:87-117``)."""
-    import tempfile
-    import os
-    # nd.load reads from a path; parse the same container from memory
-    fd, path = tempfile.mkstemp(suffix=".params")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            f.write(blob)
-        save_dict = nd.load(path)
-    finally:
-        os.unlink(path)
+    save_dict = nd.load_buffer(blob)
     arg_params, aux_params = {}, {}
     for k, v in save_dict.items():
         if k.startswith("arg:"):

@@ -73,8 +73,13 @@ int Fail(const char *where) {
     if (value != nullptr) {
       PyObject *s = PyObject_Str(value);
       if (s != nullptr) {
-        msg += ": ";
-        msg += PyUnicode_AsUTF8(s);
+        const char *utf8 = PyUnicode_AsUTF8(s);
+        if (utf8 != nullptr) {
+          msg += ": ";
+          msg += utf8;
+        } else {
+          PyErr_Clear();  // non-UTF8-encodable message; keep `where` only
+        }
         Py_DECREF(s);
       }
     }

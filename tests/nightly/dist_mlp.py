@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Distributed data-parallel training smoke (reference
+``tests/nightly/dist_lenet.py`` / ``multi_lenet.py``): each worker trains
+on its shard of a synthetic dataset with ``kvstore=dist_sync_tpu``; the
+job asserts the model converges and that every worker ends with
+bit-identical parameters (the dist_sync exactness contract,
+SURVEY §5 hard part 4).
+
+    python tools/launch.py -n 2 --launcher local -- \
+        python tests/nightly/dist_mlp.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync_tpu")
+    rank, nworker = kv.rank, kv.num_workers
+
+    rng = np.random.RandomState(7)           # same data on every worker
+    n = 1024
+    X = rng.normal(0, 1, (n, 16)).astype("f")
+    Y = (X @ rng.normal(0, 1, (16, 4))).argmax(1).astype("f")
+    # shard by rank (the reference's num_parts/part_index contract)
+    Xs, Ys = X[rank::nworker], Y[rank::nworker]
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=64, shuffle=True)
+    mod = mx.mod.Module(net)
+    mod.fit(it, num_epoch=12, kvstore=kv,
+            optimizer="sgd", optimizer_params={"learning_rate": 0.25},
+            initializer=mx.init.Xavier(rnd_type="gaussian", magnitude=2.0))
+
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, "worker %d accuracy %.3f" % (rank, acc)
+
+    # cross-worker parameter equality: allreduce(params)/nworker == params
+    from mxnet_tpu.parallel.collectives import global_allreduce
+    arg_params, _ = mod.get_params()
+    for name in sorted(arg_params):
+        mine = arg_params[name].asnumpy()
+        mean = np.asarray(global_allreduce(mine)) / nworker
+        np.testing.assert_allclose(mine, mean, rtol=1e-5, atol=1e-6,
+                                   err_msg="param %s diverged" % name)
+    kv._barrier()
+    print("worker %d/%d: dist mlp acc=%.3f, params identical across "
+          "workers" % (rank, nworker, acc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

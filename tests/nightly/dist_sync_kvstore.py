@@ -1,0 +1,52 @@
+#!/usr/bin/env python
+"""Multi-process dist_sync correctness (reference
+``tests/nightly/dist_sync_kvstore.py:20-47``): every worker pushes
+rank-dependent integer values; pulls must equal the exact sum over
+workers, for small and large (sharded-in-the-reference) keys.
+
+Run under the local launcher (the reference's local-tracker trick for
+testing multi-node on one box)::
+
+    python tools/launch.py -n 2 --launcher local -- \
+        python tests/nightly/dist_sync_kvstore.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync_tpu")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker == int(os.environ.get("MXTPU_NUM_PROCESSES", 1)), \
+        (nworker, os.environ.get("MXTPU_NUM_PROCESSES"))
+
+    shapes = {3: (4, 4), 99: (512, 128)}   # small + BIGARRAY-sized key
+    # rank-0-style init (reference kvstore_dist.h:63-80: only one worker
+    # initializes; here init is deterministic so every rank can do it)
+    for key, shape in shapes.items():
+        kv.init(key, mx.nd.ones(shape))
+
+    for it in range(3):
+        for key, shape in shapes.items():
+            kv.push(key, mx.nd.ones(shape) * (rank + 1 + it))
+            out = mx.nd.zeros(shape)
+            kv.pull(key, out=out)
+            expect = sum(r + 1 + it for r in range(nworker))
+            got = out.asnumpy()
+            assert np.allclose(got, expect), \
+                "iter %d key %s: got %s expect %s" % (it, key,
+                                                      got.flat[0], expect)
+    kv._barrier()
+    print("worker %d/%d: dist_sync kvstore exact-sum OK" % (rank, nworker))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""Multi-process distributed tests, run through the local launcher the
+way the reference runs its nightly dist tests on one box
+(``tools/launch.py -n N --launcher local``, dmlc local-tracker analog)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _launch(script, n=2, timeout=420):
+    env = dict(os.environ)
+    env.pop("MXTPU_COORDINATOR", None)   # never nest coordination scopes
+    return subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", str(n), "--launcher", "local", "--",
+         sys.executable, os.path.join(_ROOT, "tests", "nightly", script)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=_ROOT)
+
+
+def test_dist_sync_kvstore_two_workers():
+    res = _launch("dist_sync_kvstore.py")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("exact-sum OK") == 2, res.stdout + res.stderr
+
+
+def test_dist_mlp_two_workers():
+    res = _launch("dist_mlp.py")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert res.stdout.count("params identical") == 2, \
+        res.stdout + res.stderr

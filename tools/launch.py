@@ -49,6 +49,10 @@ def launch_local(args):
     procs = []
     for rank in range(args.num_workers):
         env = dict(os.environ)
+        # a site-injected TPU backend would initialize XLA at interpreter
+        # start, before jax.distributed.initialize can run — strip it;
+        # local mode is CPU-only by design
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         env.update({
             "MXTPU_COORDINATOR": coordinator,
             "MXTPU_NUM_PROCESSES": str(args.num_workers),
