@@ -16,15 +16,22 @@ if _os.environ.get("MXTPU_COORDINATOR"):
     # contract is set by tools/launch.py; on a real TPU pod slice the
     # envs are absent and jax discovers the topology itself.
     import jax as _jax
+    _missing = [v for v in ("MXTPU_NUM_PROCESSES", "MXTPU_PROCESS_ID")
+                if v not in _os.environ]
+    if _missing:
+        raise RuntimeError(
+            "MXTPU_COORDINATOR is set but %s %s missing — the launcher "
+            "contract (tools/launch.py) requires all three MXTPU_* vars"
+            % (" and ".join(_missing),
+               "is" if len(_missing) == 1 else "are"))
     try:
-        _already = _jax._src.distributed.global_state.client is not None
-    except Exception:
-        _already = False
-    if not _already:
         _jax.distributed.initialize(
             coordinator_address=_os.environ["MXTPU_COORDINATOR"],
             num_processes=int(_os.environ["MXTPU_NUM_PROCESSES"]),
             process_id=int(_os.environ["MXTPU_PROCESS_ID"]))
+    except RuntimeError as _e:
+        if "already initialized" not in str(_e):
+            raise
 
 from . import base
 from .base import (Context, MXNetError, cpu, gpu, tpu, current_context)

@@ -22,14 +22,18 @@ __all__ = ["global_allreduce", "barrier", "psum_over_mesh",
            "broadcast_from_rank0"]
 
 
+def _process_count():
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+
 def broadcast_from_rank0(value):
     """Every process returns process 0's ``value`` (the reference's
     rank-0-only init push + pull, ``kvstore_dist.h:63-80``)."""
-    try:
-        n_proc = jax.process_count()
-    except Exception:
-        n_proc = 1
-    if n_proc <= 1:
+    if _process_count() <= 1:
         return value
     from jax.experimental import multihost_utils
     return jnp.asarray(
@@ -43,11 +47,7 @@ def global_allreduce(value):
     ``KVStoreDist::Push_`` network path; models trained through the fused
     step never call it — their psum is inside the compiled step.
     """
-    try:
-        n_proc = jax.process_count()
-    except Exception:
-        n_proc = 1
-    if n_proc <= 1:
+    if _process_count() <= 1:
         return value
     # one device per process: each process contributes exactly one shard
     # regardless of how many local devices it has
@@ -84,7 +84,7 @@ def barrier():
     """Cross-process rendezvous (reference ``ps::Postoffice::Barrier``,
     ``kvstore_dist.h:142-145``)."""
     try:
-        if jax.process_count() > 1:
+        if _process_count() > 1:
             # a tiny allreduce acts as the barrier on the coordination svc
             jnp.zeros(()).block_until_ready()
             from jax.experimental import multihost_utils

@@ -23,6 +23,27 @@ _STATE = {"mode": "symbolic", "filename": "profile.json", "running": False,
           "events": [], "jax_trace_dir": None}
 
 
+def _env_autostart():
+    """MXNET_PROFILER_AUTOSTART=1 starts profiling at import
+    (reference ``docs/how_to/env_var.md:60-67``); MXNET_PROFILER_MODE
+    selects symbolic-only (0) vs all (1)."""
+    import os
+    if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+        mode = "all" if os.environ.get("MXNET_PROFILER_MODE",
+                                       "0") == "1" else "symbolic"
+        profiler_set_config(mode=mode)
+        profiler_set_state("run")
+        # env-only workflow: dump at interpreter exit (the reference
+        # dumps on MXNotifyShutdown when autostarted)
+        import atexit
+
+        def _dump_at_exit():
+            profiler_set_state("stop")
+            dump_profile()
+
+        atexit.register(_dump_at_exit)
+
+
 def profiler_set_config(mode="symbolic", filename="profile.json"):
     """Configure what to profile (reference ``profiler.py:10``):
     mode 'symbolic' records executor-level ops, 'all' also records
@@ -103,3 +124,5 @@ def dump_profile():
     with open(fname, "w") as f:
         json.dump({"traceEvents": out}, f, indent=2)
     return fname
+
+_env_autostart()
