@@ -30,7 +30,10 @@ if _os.environ.get("MXTPU_COORDINATOR"):
             num_processes=int(_os.environ["MXTPU_NUM_PROCESSES"]),
             process_id=int(_os.environ["MXTPU_PROCESS_ID"]))
     except RuntimeError as _e:
-        if "already initialized" not in str(_e):
+        # tolerate a host program that already initialized jax.distributed
+        # (jax wording varies across versions)
+        if "already initialized" not in str(_e) and \
+                "only be called once" not in str(_e):
             raise
 
 from . import base

@@ -87,3 +87,58 @@ def lib():
                 _LIB = None
         _TRIED = True
         return _LIB
+
+
+# ---------------------------------------------------------------------
+# native image data loader (native/mxtpu_dataloader.cc)
+_DL_LIB = None
+_DL_TRIED = False
+
+
+def _dl_declare(lib):
+    c = ctypes
+    lib.mxt_loader_create.restype = c.c_void_p
+    lib.mxt_loader_create.argtypes = [
+        c.c_char_p, c.c_int, c.c_int, c.c_int, c.c_int, c.c_int,
+        c.c_int, c.c_int, c.c_int, c.c_int, c.c_float,
+        c.POINTER(c.c_float), c.POINTER(c.c_float),
+        c.c_int, c.c_uint32, c.c_int, c.c_int]
+    lib.mxt_loader_count.restype = c.c_int64
+    lib.mxt_loader_count.argtypes = [c.c_void_p]
+    lib.mxt_loader_failures.restype = c.c_int64
+    lib.mxt_loader_failures.argtypes = [c.c_void_p]
+    lib.mxt_loader_reset.argtypes = [c.c_void_p]
+    lib.mxt_loader_next.restype = c.c_int
+    lib.mxt_loader_next.argtypes = [c.c_void_p,
+                                    c.POINTER(c.c_float),
+                                    c.POINTER(c.c_float)]
+    lib.mxt_loader_free.argtypes = [c.c_void_p]
+    return lib
+
+
+def dataloader_lib():
+    """The native image loader library, or None if unavailable."""
+    global _DL_LIB, _DL_TRIED
+    if _DL_LIB is not None or _DL_TRIED:
+        return _DL_LIB
+    with _LOCK:
+        if _DL_LIB is not None or _DL_TRIED:
+            return _DL_LIB
+        path = os.path.join(os.path.dirname(__file__), "lib",
+                            "libmxtpu_dataloader.so")
+        if not os.path.exists(path):
+            src_dir = os.path.join(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__))), "native")
+            if os.path.exists(os.path.join(src_dir, "Makefile")):
+                try:
+                    subprocess.run(["make", "-C", src_dir], check=True,
+                                   capture_output=True)
+                except Exception:
+                    pass
+        if os.path.exists(path):
+            try:
+                _DL_LIB = _dl_declare(ctypes.CDLL(path))
+            except OSError:
+                _DL_LIB = None
+        _DL_TRIED = True
+        return _DL_LIB

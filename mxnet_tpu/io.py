@@ -552,7 +552,7 @@ def _as_shape(s):
     return tuple(int(x) for x in s)
 
 
-class ImageRecordIter(DataIter):
+class PyImageRecordIter(DataIter):
     """RecordIO image iterator with threaded decode + augmentation.
 
     Python-native equivalent of ``src/io/iter_image_recordio_2.cc:28-120``
@@ -774,4 +774,125 @@ def _decode_lrec_mod(lrec):
 
 
 # Factory parity with the registered C++ iterators
+
+
+class NativeImageRecordIter(DataIter):
+    """RecordIO image iterator backed by the native C++ loader
+    (``native/mxtpu_dataloader.cc``): libjpeg/libpng decode + augment on
+    a C++ thread pool — true decode parallelism, no GIL (the analog of
+    the reference's OMP ``ImageRecordIOParser2``,
+    ``iter_image_recordio_2.cc:104-120``).  Same record bytes, same
+    augmentations (resize-short, random/center crop, mirror, mean/std),
+    same BGR/CHW float output as the python path."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, scale=1.0, resize=-1, preprocess_threads=4,
+                 part_index=0, num_parts=1, seed=0, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        super().__init__(int(batch_size))
+        from ._native import dataloader_lib
+        import ctypes
+        self._lib = dataloader_lib()
+        assert self._lib is not None, "native data loader unavailable"
+        self.data_shape = _as_shape(data_shape)
+        assert len(self.data_shape) == 3
+        self.label_width = int(label_width)
+        self.data_name = data_name
+        self.label_name = label_name
+        c, h, w = self.data_shape
+        mean = (ctypes.c_float * 3)(float(mean_b), float(mean_g),
+                                    float(mean_r))     # BGR plane order
+        std = (ctypes.c_float * 3)(float(std_b), float(std_g),
+                                   float(std_r))
+        self._handle = self._lib.mxt_loader_create(
+            str(path_imgrec).encode(), int(batch_size), int(c), int(h),
+            int(w), int(label_width), int(_parse_bool(shuffle)),
+            int(_parse_bool(rand_crop)), int(_parse_bool(rand_mirror)),
+            int(resize), float(scale), mean, std,
+            int(preprocess_threads), int(seed) & 0xffffffff,
+            int(part_index), int(num_parts))
+        if not self._handle:
+            raise MXNetError("cannot open record file %s" % path_imgrec)
+        self.num_samples = int(self._lib.mxt_loader_count(self._handle))
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 \
+            else (self.batch_size, self.label_width)
+        return [DataDesc(self.label_name, shape)]
+
+    def reset(self):
+        self._lib.mxt_loader_reset(self._handle)
+
+    def next(self):
+        import ctypes
+        c, h, w = self.data_shape
+        data = np.empty((self.batch_size, c, h, w), np.float32)
+        label = np.empty((self.batch_size, self.label_width), np.float32)
+        fresh = self._lib.mxt_loader_next(
+            self._handle,
+            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if fresh <= 0:
+            raise StopIteration
+        if self.label_width == 1:
+            label = label.reshape(self.batch_size)
+        return DataBatch(data=[array(data)], label=[array(label)],
+                         pad=self.batch_size - fresh)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_handle", None):
+                self._lib.mxt_loader_free(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+# python-path-only options and their defaults; passing one at a
+# NON-default value selects the python iterator (the native loader does
+# not implement these augmentations)
+_PY_ONLY_DEFAULTS = {"mean_img": None, "max_random_scale": 1.0,
+                     "min_random_scale": 1.0, "max_rotate_angle": 0,
+                     "max_aspect_ratio": 0.0, "random_h": 0,
+                     "random_s": 0, "random_l": 0, "round_batch": True}
+
+
+def ImageRecordIter(*args, **kwargs):
+    """Factory: native C++ loader when available and sufficient, python
+    fallback otherwise (same signature, reference
+    ``MXNET_REGISTER_IO_ITER(ImageRecordIter)``).  Force a backend with
+    ``backend='native'|'python'``."""
+    backend = kwargs.pop("backend", "auto")
+    if backend != "python":
+        from ._native import dataloader_lib
+
+        def _non_default(k):
+            if k not in kwargs:
+                return False
+            v, d = kwargs[k], _PY_ONLY_DEFAULTS[k]
+            try:
+                return float(v) != float(d)
+            except (TypeError, ValueError):
+                return v != d
+
+        uses_py_only = any(_non_default(k) for k in _PY_ONLY_DEFAULTS)
+        if dataloader_lib() is not None and not uses_py_only:
+            try:
+                return NativeImageRecordIter(*args, **kwargs)
+            except (MXNetError, AssertionError):
+                if backend == "native":
+                    raise
+    if backend == "native":
+        raise MXNetError("native data loader unavailable")
+    return PyImageRecordIter(*args, **kwargs)
+
+
 ImageRecordIter_v1 = ImageRecordIter

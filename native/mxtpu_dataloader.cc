@@ -1,0 +1,473 @@
+// mxtpu native image data loader: RecordIO scan + parallel JPEG/PNG
+// decode + augment, the TPU-native analog of the reference's
+// ImageRecordIOParser2 (src/io/iter_image_recordio_2.cc: OMP decode
+// threads) + default augmenter (src/io/image_aug_default.cc: resize,
+// random/center crop, mirror, mean/std normalize).
+//
+// Design: mxt_loader_next() fills the caller's batch buffer with a
+// parallel-for over samples on an internal thread pool — decode
+// parallelism without Python's GIL.  Double buffering is layered above
+// (python PrefetchingIter / the host dependency engine), mirroring the
+// reference's Prefetcher(BatchLoader(Parser)) chain.
+//
+// Record container: dmlc RecordIO (magic 0xced7230a, 29-bit length,
+// pad-to-4) holding IRHeader{u32 flag, f32 label, u64 id, u64 id2}
+// (+ flag extra f32 labels when flag>0) + JPEG/PNG payload — identical
+// bytes to the reference and to mxnet_tpu/recordio.py.
+//
+// Output layout: float32 CHW, channels in BGR order (the reference's
+// OpenCV convention, matched by the python ImageRecordIter).
+//
+// Build: native/Makefile -> mxnet_tpu/lib/libmxtpu_dataloader.so
+
+#include <fcntl.h>
+#include <cstdio>  // jpeglib.h needs FILE
+#include <jpeglib.h>
+#include <png.h>
+#include <setjmp.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+struct Image {
+  int h = 0, w = 0;            // decoded size
+  std::vector<uint8_t> rgb;    // HWC, RGB
+};
+
+// ---------------------------------------------------------------- JPEG
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jb;
+};
+
+void JpegErrExit(j_common_ptr cinfo) {
+  longjmp(reinterpret_cast<JpegErr *>(cinfo->err)->jb, 1);
+}
+
+bool DecodeJpeg(const uint8_t *buf, size_t len, Image *out) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = JpegErrExit;
+  if (setjmp(jerr.jb)) {
+    jpeg_destroy_decompress(&cinfo);
+    return false;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, buf, len);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  out->w = cinfo.output_width;
+  out->h = cinfo.output_height;
+  out->rgb.resize(size_t(out->w) * out->h * 3);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t *row = out->rgb.data() + size_t(cinfo.output_scanline) *
+                                         out->w * 3;
+    jpeg_read_scanlines(&cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return true;
+}
+
+// ----------------------------------------------------------------- PNG
+bool DecodePng(const uint8_t *buf, size_t len, Image *out) {
+  png_image img;
+  std::memset(&img, 0, sizeof(img));
+  img.version = PNG_IMAGE_VERSION;
+  if (!png_image_begin_read_from_memory(&img, buf, len)) return false;
+  img.format = PNG_FORMAT_RGB;
+  out->w = img.width;
+  out->h = img.height;
+  out->rgb.resize(PNG_IMAGE_SIZE(img));
+  if (!png_image_finish_read(&img, nullptr, out->rgb.data(), 0, nullptr)) {
+    png_image_free(&img);
+    return false;
+  }
+  return true;
+}
+
+bool Decode(const uint8_t *buf, size_t len, Image *out) {
+  if (len >= 8 && buf[0] == 0x89 && buf[1] == 'P' && buf[2] == 'N' &&
+      buf[3] == 'G')
+    return DecodePng(buf, len, out);
+  return DecodeJpeg(buf, len, out);
+}
+
+// ------------------------------------------------------------ augment
+// bilinear resize RGB HWC -> (nh, nw)
+void Resize(const Image &src, int nh, int nw, Image *dst) {
+  dst->h = nh;
+  dst->w = nw;
+  dst->rgb.resize(size_t(nh) * nw * 3);
+  const float sy = float(src.h) / nh, sx = float(src.w) / nw;
+  for (int y = 0; y < nh; ++y) {
+    float fy = (y + 0.5f) * sy - 0.5f;
+    int y0 = std::max(0, std::min(src.h - 1, int(std::floor(fy))));
+    int y1 = std::min(src.h - 1, y0 + 1);
+    float wy = fy - y0;
+    for (int x = 0; x < nw; ++x) {
+      float fx = (x + 0.5f) * sx - 0.5f;
+      int x0 = std::max(0, std::min(src.w - 1, int(std::floor(fx))));
+      int x1 = std::min(src.w - 1, x0 + 1);
+      float wx = fx - x0;
+      for (int c = 0; c < 3; ++c) {
+        float p00 = src.rgb[(size_t(y0) * src.w + x0) * 3 + c];
+        float p01 = src.rgb[(size_t(y0) * src.w + x1) * 3 + c];
+        float p10 = src.rgb[(size_t(y1) * src.w + x0) * 3 + c];
+        float p11 = src.rgb[(size_t(y1) * src.w + x1) * 3 + c];
+        float v = p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+                  p10 * wy * (1 - wx) + p11 * wy * wx;
+        dst->rgb[(size_t(y) * nw + x) * 3 + c] =
+            uint8_t(std::max(0.f, std::min(255.f, v + 0.5f)));
+      }
+    }
+  }
+}
+
+// ------------------------------------------------------------- loader
+struct Loader {
+  int fd = -1;
+  std::vector<std::pair<uint64_t, uint32_t>> records;  // offset, payload len
+  std::vector<uint32_t> order;
+  size_t cursor = 0;
+
+  int batch, channels, height, width, label_width;
+  bool shuffle, rand_crop, rand_mirror;
+  int resize_short;
+  float scale;
+  float mean[3] = {0, 0, 0}, stdv[3] = {1, 1, 1};
+  std::mt19937 rng;
+  uint32_t seed;
+  int epoch = 0;
+
+  // thread pool.  Each ParallelFor publishes one immutable BatchWork;
+  // stragglers from a previous batch still hold their own shared_ptr and
+  // can only claim indices from that (exhausted) batch's counter, so a
+  // new batch can never race with an old worker (no shared mutable
+  // task/counter across generations).
+  struct BatchWork {
+    std::function<void(int)> fn;
+    std::atomic<int> next{0};
+    std::atomic<int> done{0};
+    int n = 0;
+  };
+  std::vector<std::thread> threads;
+  std::shared_ptr<BatchWork> batch_work;
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  bool stop_pool = false;
+  uint64_t generation = 0;
+  std::atomic<int64_t> failures{0};
+
+  std::string error;
+
+  ~Loader() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop_pool = true;
+      ++generation;
+    }
+    cv_work.notify_all();
+    for (auto &t : threads) t.join();
+    if (fd >= 0) close(fd);
+  }
+
+  void StartPool(int n) {
+    for (int i = 0; i < n; ++i) {
+      threads.emplace_back([this]() {
+        uint64_t seen_gen = 0;
+        for (;;) {
+          std::shared_ptr<BatchWork> work;
+          {
+            std::unique_lock<std::mutex> lk(mu);
+            cv_work.wait(lk, [&] {
+              return stop_pool || generation != seen_gen;
+            });
+            if (stop_pool) return;
+            seen_gen = generation;
+            work = batch_work;
+          }
+          if (!work) continue;
+          for (;;) {
+            int i = work->next.fetch_add(1);
+            if (i >= work->n) break;
+            work->fn(i);
+            if (work->done.fetch_add(1) + 1 == work->n) {
+              std::lock_guard<std::mutex> lk(mu);
+              cv_done.notify_all();
+            }
+          }
+        }
+      });
+    }
+  }
+
+  void ParallelFor(int n, std::function<void(int)> fn) {
+    if (threads.empty()) {
+      for (int i = 0; i < n; ++i) fn(i);
+      return;
+    }
+    auto work = std::make_shared<BatchWork>();
+    work->fn = std::move(fn);
+    work->n = n;
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      batch_work = work;
+      ++generation;
+    }
+    cv_work.notify_all();
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [&] { return work->done.load() >= work->n; });
+  }
+
+  bool ScanOffsets() {
+    // walk the record stream; multi-part records (cflag 1/2/3) belong to
+    // one logical record starting at the first part
+    uint64_t pos = 0;
+    off_t size = lseek(fd, 0, SEEK_END);
+    std::vector<uint8_t> head(8);
+    bool in_multi = false;
+    uint64_t start = 0;
+    while (pos + 8 <= uint64_t(size)) {
+      if (pread(fd, head.data(), 8, pos) != 8) break;
+      uint32_t magic, lrec;
+      std::memcpy(&magic, head.data(), 4);
+      std::memcpy(&lrec, head.data() + 4, 4);
+      if (magic != kMagic) {
+        error = "bad record magic";
+        return false;
+      }
+      uint32_t cflag = lrec >> 29, length = lrec & ((1u << 29) - 1);
+      if (cflag == 0 || cflag == 1) {
+        start = pos;
+        in_multi = (cflag == 1);
+        if (cflag == 0)
+          records.emplace_back(start, 0);
+      } else if ((cflag == 3) && in_multi) {
+        records.emplace_back(start, 0);
+        in_multi = false;
+      }
+      pos += 8 + length + ((4 - (length & 3)) & 3);
+    }
+    return true;
+  }
+
+  // read the full (possibly multi-part) logical record payload at
+  // offset.  Multi-part records are payloads that contained the escaped
+  // magic word: the writer split at each occurrence, so the reader
+  // re-inserts the magic between parts (recordio.py read(),
+  // mxtpu_runtime.cc MXTRecordReaderNext do the same).
+  bool ReadRecord(uint64_t pos, std::vector<uint8_t> *payload) {
+    payload->clear();
+    uint8_t head[8];
+    bool first = true;
+    for (;;) {
+      if (pread(fd, head, 8, pos) != 8) return false;
+      uint32_t lrec;
+      std::memcpy(&lrec, head + 4, 4);
+      uint32_t cflag = lrec >> 29, length = lrec & ((1u << 29) - 1);
+      if (!first) {
+        const uint32_t magic = kMagic;
+        size_t old = payload->size();
+        payload->resize(old + 4);
+        std::memcpy(payload->data() + old, &magic, 4);
+      }
+      size_t old = payload->size();
+      payload->resize(old + length);
+      if (pread(fd, payload->data() + old, length, pos + 8) !=
+          ssize_t(length))
+        return false;
+      pos += 8 + length + ((4 - (length & 3)) & 3);
+      if (cflag == 0 || cflag == 3) return true;
+      first = false;
+    }
+  }
+
+  // decode + augment one sample into the batch buffers
+  bool LoadOne(const std::vector<uint8_t> &payload, uint32_t sample_seed,
+               float *data_out, float *label_out) {
+    if (payload.size() < 24) return false;
+    uint32_t flag;
+    float single_label;
+    std::memcpy(&flag, payload.data(), 4);
+    std::memcpy(&single_label, payload.data() + 4, 4);
+    size_t off = 24;
+    if (flag > 0) {
+      // corrupt headers must not drive reads past the payload
+      if (size_t(flag) > (payload.size() - off) / 4) return false;
+      for (int i = 0; i < label_width; ++i) {
+        float v = 0;
+        if (i < int(flag)) std::memcpy(&v, payload.data() + off + 4 * i, 4);
+        label_out[i] = v;
+      }
+      off += size_t(flag) * 4;
+    } else {
+      for (int i = 0; i < label_width; ++i) label_out[i] = single_label;
+    }
+    if (off >= payload.size()) return false;
+    Image img;
+    if (!Decode(payload.data() + off, payload.size() - off, &img))
+      return false;
+
+    std::mt19937 srng(sample_seed);
+    // resize short edge
+    if (resize_short > 0 && std::min(img.h, img.w) != resize_short) {
+      float r = float(resize_short) / std::min(img.h, img.w);
+      Image tmp;
+      Resize(img, std::max(height, int(img.h * r + 0.5f)),
+             std::max(width, int(img.w * r + 0.5f)), &tmp);
+      img = std::move(tmp);
+    }
+    if (img.h < height || img.w < width) {
+      Image tmp;
+      Resize(img, std::max(img.h, height), std::max(img.w, width), &tmp);
+      img = std::move(tmp);
+    }
+    int y0, x0;
+    if (rand_crop) {
+      y0 = int(srng() % uint32_t(img.h - height + 1));
+      x0 = int(srng() % uint32_t(img.w - width + 1));
+    } else {
+      y0 = (img.h - height) / 2;
+      x0 = (img.w - width) / 2;
+    }
+    bool mirror = rand_mirror && (srng() & 1);
+    // CHW float, BGR order, normalize
+    for (int c = 0; c < channels; ++c) {
+      int src_c = channels == 3 ? 2 - c : 0;  // BGR out of RGB decode
+      float m = mean[c], s = stdv[c];
+      float *plane = data_out + size_t(c) * height * width;
+      for (int y = 0; y < height; ++y) {
+        const uint8_t *row =
+            img.rgb.data() + (size_t(y0 + y) * img.w + x0) * 3;
+        float *orow = plane + size_t(y) * width;
+        for (int x = 0; x < width; ++x) {
+          int sx = mirror ? (width - 1 - x) : x;
+          orow[x] = (float(row[size_t(sx) * 3 + src_c]) - m) / s * scale;
+        }
+      }
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *mxt_loader_create(const char *rec_path, int batch, int channels,
+                        int height, int width, int label_width,
+                        int shuffle, int rand_crop, int rand_mirror,
+                        int resize_short, float scale, const float *mean3,
+                        const float *std3, int num_threads, uint32_t seed,
+                        int part_index, int num_parts) {
+  auto *L = new Loader();
+  L->fd = open(rec_path, O_RDONLY);
+  if (L->fd < 0) {
+    delete L;
+    return nullptr;
+  }
+  L->batch = batch;
+  L->channels = channels;
+  L->height = height;
+  L->width = width;
+  L->label_width = std::max(1, label_width);
+  L->shuffle = shuffle != 0;
+  L->rand_crop = rand_crop != 0;
+  L->rand_mirror = rand_mirror != 0;
+  L->resize_short = resize_short;
+  L->scale = scale;
+  if (mean3)
+    for (int i = 0; i < 3; ++i) L->mean[i] = mean3[i];
+  if (std3)
+    for (int i = 0; i < 3; ++i) L->stdv[i] = std3[i];
+  L->seed = seed;
+  L->rng.seed(seed);
+  if (!L->ScanOffsets()) {
+    delete L;
+    return nullptr;
+  }
+  // shard for data parallelism (num_parts/part_index contract)
+  if (num_parts > 1) {
+    size_t n = L->records.size() / num_parts;
+    std::vector<std::pair<uint64_t, uint32_t>> shard(
+        L->records.begin() + part_index * n,
+        L->records.begin() + (part_index + 1) * n);
+    L->records.swap(shard);
+  }
+  L->order.resize(L->records.size());
+  for (size_t i = 0; i < L->order.size(); ++i) L->order[i] = uint32_t(i);
+  if (L->shuffle)
+    std::shuffle(L->order.begin(), L->order.end(), L->rng);
+  L->StartPool(std::max(1, num_threads));
+  return L;
+}
+
+int64_t mxt_loader_count(void *h) {
+  return int64_t(static_cast<Loader *>(h)->records.size());
+}
+
+void mxt_loader_reset(void *h) {
+  auto *L = static_cast<Loader *>(h);
+  L->cursor = 0;
+  ++L->epoch;
+  if (L->shuffle) {
+    L->rng.seed(L->seed + uint32_t(L->epoch));
+    std::shuffle(L->order.begin(), L->order.end(), L->rng);
+  }
+}
+
+// Fill one batch.  Returns the number of fresh (non-wrapped) samples:
+// == batch mid-epoch, < batch for the final padded batch, 0 at epoch end.
+// Corrupt records are zero-filled and counted (mxt_loader_failures) but
+// never end the epoch early — the reference parser likewise skips bad
+// records and keeps going.
+int mxt_loader_next(void *h, float *data, float *label) {
+  auto *L = static_cast<Loader *>(h);
+  size_t n = L->order.size();
+  if (L->cursor >= n || n == 0) return 0;
+  int fresh = int(std::min<size_t>(L->batch, n - L->cursor));
+  size_t plane = size_t(L->channels) * L->height * L->width;
+  uint32_t epoch_seed = L->seed * 2654435761u + uint32_t(L->epoch);
+  L->ParallelFor(L->batch, [&, n](int i) {
+    size_t idx = L->order[(L->cursor + i) % n];  // wrap-pad to epoch start
+    std::vector<uint8_t> payload;
+    if (!L->ReadRecord(L->records[idx].first, &payload) ||
+        !L->LoadOne(payload, epoch_seed + uint32_t(idx) * 2246822519u,
+                    data + size_t(i) * plane,
+                    label + size_t(i) * L->label_width)) {
+      std::memset(data + size_t(i) * plane, 0, plane * sizeof(float));
+      std::memset(label + size_t(i) * L->label_width, 0,
+                  L->label_width * sizeof(float));
+      L->failures.fetch_add(1);
+    }
+  });
+  L->cursor += fresh;
+  return fresh;
+}
+
+// cumulative count of records that failed to read/decode (zero-filled)
+int64_t mxt_loader_failures(void *h) {
+  return static_cast<Loader *>(h)->failures.load();
+}
+
+void mxt_loader_free(void *h) { delete static_cast<Loader *>(h); }
+
+}  // extern "C"
