@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Fine-tune a saved checkpoint on a new dataset (reference
+``example/image-classification/fine-tune.py``): load ``--pretrained-model``,
+replace the classifier with a fresh ``num_classes`` head, and train with
+the backbone initialized from the checkpoint (``allow_missing`` lets the
+new head initialize randomly)."""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import mxnet_tpu as mx
+from common import fit, data
+
+
+def get_fine_tune_model(symbol, arg_params, num_classes,
+                        layer_name="flatten0"):
+    """Cut the graph at ``layer_name`` and attach a new classifier
+    (reference fine-tune.py ``get_fine_tune_model``)."""
+    all_layers = symbol.get_internals()
+    net = all_layers[layer_name + "_output"]
+    net = mx.sym.FullyConnected(data=net, num_hidden=num_classes,
+                                name="fc_new")
+    net = mx.sym.SoftmaxOutput(data=net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc_new")}
+    return net, new_args
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(
+        description="fine-tune a pretrained model",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--pretrained-model", type=str, required=True,
+                        help="checkpoint prefix to start from")
+    parser.add_argument("--pretrained-epoch", type=int, default=0)
+    parser.add_argument("--layer-before-fullc", type=str, default="flatten0",
+                        help="graph node to cut at")
+    parser.add_argument("--num-classes", type=int, required=True)
+    fit.add_fit_args(parser)
+    data.add_data_args(parser)
+    parser.set_defaults(image_shape="3,224,224", num_epochs=30,
+                        lr=0.01, lr_step_epochs="20")
+    args = parser.parse_args()
+
+    sym, arg_params, aux_params = mx.model.load_checkpoint(
+        args.pretrained_model, args.pretrained_epoch)
+    sym, arg_params = get_fine_tune_model(
+        sym, arg_params, args.num_classes, args.layer_before_fullc)
+
+    def loader(a, kv):
+        return data.get_rec_iter(a, kv)
+
+    fit.fit(args, sym, loader,
+            arg_params=arg_params, aux_params=aux_params)

@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Minimal SSD-style detector (reference ``example/ssd``): a small conv
+backbone, per-scale class + box-offset heads, `MultiBoxPrior` anchors,
+`MultiBoxTarget` training targets and `MultiBoxDetection` + NMS decode —
+the full contrib detection-op pipeline, sized to run in seconds on
+synthetic data (one bright square per image; the detector must localize
+it).
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net(num_classes=1, num_anchors=3):
+    # anchors/cell = len(sizes) + len(ratios) - 1 = 3
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    body = data
+    for i, nf in enumerate((16, 32, 64)):
+        body = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=nf, name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="relu")
+        body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                              pool_type="max")
+    # single-scale heads on the 8x8 map
+    cls_pred = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=num_anchors * (num_classes + 1),
+                                  name="cls_pred")
+    loc_pred = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=num_anchors * 4,
+                                  name="loc_pred")
+    anchors = mx.sym.MultiBoxPrior(
+        body, sizes=(0.3, 0.5), ratios=(1.0, 2.0), name="anchors")
+    anchors = mx.sym.Reshape(anchors, shape=(1, -1, 4))
+    # (b, #anch*(C+1), H, W) -> (b, #cells*#anch, C+1)
+    cls_pred_t = mx.sym.transpose(cls_pred, axes=(0, 2, 3, 1))
+    cls_pred_t = mx.sym.Reshape(cls_pred_t, shape=(0, -1, num_classes + 1))
+    cls_prob_t = mx.sym.transpose(cls_pred_t, axes=(0, 2, 1))
+    loc_pred_t = mx.sym.transpose(loc_pred, axes=(0, 2, 3, 1))
+    loc_pred_t = mx.sym.Flatten(loc_pred_t)
+    tgt = mx.sym.MultiBoxTarget(
+        anchors, label, cls_prob_t, overlap_threshold=0.5,
+        negative_mining_ratio=3.0, name="tgt")
+    loc_target, loc_mask, cls_target = tgt[0], tgt[1], tgt[2]
+    cls_prob = mx.sym.SoftmaxOutput(mx.sym.Reshape(
+        cls_pred_t, shape=(-1, num_classes + 1)),
+        mx.sym.Reshape(cls_target, shape=(-1,)),
+        ignore_label=-1, use_ignore=True, normalization="valid",
+        name="cls_prob")
+    loc_loss = mx.sym.smooth_l1(loc_pred_t * loc_mask - loc_target,
+                                scalar=1.0)
+    loc_loss = mx.sym.MakeLoss(mx.sym.sum(loc_loss) /
+                               mx.sym.sum(loc_mask + 1e-6),
+                               name="loc_loss")
+    det = mx.sym.MultiBoxDetection(
+        mx.sym.transpose(mx.sym.softmax(cls_pred_t, axis=2),
+                         axes=(0, 2, 1)),    # (b, C+1, A)
+        loc_pred_t, anchors, nms_threshold=0.5, force_suppress=True,
+        name="det")
+    return mx.sym.Group([cls_prob, loc_loss,
+                         mx.sym.BlockGrad(det, name="det_out")])
+
+
+def make_batch(rng, batch, size=64):
+    """White squares on dark noise; label = (cls, x0, y0, x1, y1)."""
+    imgs = rng.normal(0, 0.1, (batch, 3, size, size)).astype("f")
+    labels = np.full((batch, 1, 5), -1.0, "f")
+    for b in range(batch):
+        w = rng.randint(size // 4, size // 2)
+        x0 = rng.randint(0, size - w)
+        y0 = rng.randint(0, size - w)
+        imgs[b, :, y0:y0 + w, x0:x0 + w] += 1.0
+        labels[b, 0] = (0, x0 / size, y0 / size,
+                        (x0 + w) / size, (y0 + w) / size)
+    return imgs, labels
+
+
+def iou(a, b):
+    x0, y0 = max(a[0], b[0]), max(a[1], b[1])
+    x1, y1 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(0.0, x1 - x0) * max(0.0, y1 - y0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / max(ua, 1e-9)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="toy SSD")
+    parser.add_argument("--batch-size", type=int, default=16)
+    parser.add_argument("--num-batches", type=int, default=150)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    net = build_net()
+    mod = mx.mod.Module(net, data_names=("data",), label_names=("label",))
+    mod.bind(data_shapes=[mx.io.DataDesc("data",
+                                         (args.batch_size, 3, 64, 64))],
+             label_shapes=[mx.io.DataDesc("label",
+                                          (args.batch_size, 1, 5))])
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9, "wd": 1e-4})
+    for i in range(args.num_batches):
+        x, y = make_batch(rng, args.batch_size)
+        batch = mx.io.DataBatch(data=[mx.nd.array(x)],
+                                label=[mx.nd.array(y)])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        if i % 20 == 0:
+            loc = float(mod.get_outputs()[1].asnumpy().mean())
+            logging.info("batch %d loc-loss %.4f", i, loc)
+
+    # detection quality on fresh data
+    x, y = make_batch(rng, args.batch_size)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)],
+                                label=[mx.nd.array(y)]), is_train=False)
+    dets = mod.get_outputs()[2].asnumpy()   # (b, #anchors, 6)
+    hits = 0
+    for b in range(args.batch_size):
+        valid = dets[b][dets[b][:, 0] >= 0]
+        if not len(valid):
+            continue
+        best = valid[np.argmax(valid[:, 1])]
+        if iou(best[2:6], y[b, 0, 1:5]) > 0.3:
+            hits += 1
+    logging.info("detection recall@0.3IoU: %d/%d", hits, args.batch_size)
+    return 0 if hits >= args.batch_size // 2 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

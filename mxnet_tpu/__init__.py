@@ -119,3 +119,15 @@ from . import parallel
 # Custom op front-ends (reference mx.nd.Custom / mx.sym.Custom)
 ndarray.Custom = operator._custom_entry("nd")
 symbol.Custom = operator._custom_entry("sym")
+
+# contrib namespaces (reference exposes contrib ops both flat and under
+# mx.sym.contrib / mx.nd.contrib in later lines; keep both addressable)
+import types as _types
+symbol.contrib = _types.SimpleNamespace()
+ndarray.contrib = _types.SimpleNamespace()
+for _n in list(vars(symbol)):
+    if _n.startswith("_contrib_"):
+        setattr(symbol.contrib, _n[len("_contrib_"):], getattr(symbol, _n))
+for _n in list(vars(ndarray)):
+    if _n.startswith("_contrib_"):
+        setattr(ndarray.contrib, _n[len("_contrib_"):], getattr(ndarray, _n))

@@ -799,6 +799,8 @@ class NativeImageRecordIter(DataIter):
         self.data_shape = _as_shape(data_shape)
         assert len(self.data_shape) == 3
         self.label_width = int(label_width)
+        if self.label_width < 1:
+            raise MXNetError("label_width must be >= 1")
         self.data_name = data_name
         self.label_name = label_name
         c, h, w = self.data_shape
@@ -847,6 +849,26 @@ class NativeImageRecordIter(DataIter):
         return DataBatch(data=[array(data)], label=[array(label)],
                          pad=self.batch_size - fresh)
 
+    # legacy DataIter protocol (iter_next/getdata/... loop)
+    def iter_next(self):
+        try:
+            self._current = self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self._current.data
+
+    def getlabel(self):
+        return self._current.label
+
+    def getpad(self):
+        return self._current.pad
+
+    def getindex(self):
+        return None
+
     def __del__(self):
         try:
             if getattr(self, "_handle", None):
@@ -865,12 +887,26 @@ _PY_ONLY_DEFAULTS = {"mean_img": None, "max_random_scale": 1.0,
                      "random_s": 0, "random_l": 0, "round_batch": True}
 
 
+# leading positional parameters (the python class's order) — normalized
+# to kwargs so both backends see identical named arguments
+_IRI_POSITIONAL = ("path_imgrec", "data_shape", "batch_size", "path_imgidx",
+                   "label_width", "shuffle")
+
+
 def ImageRecordIter(*args, **kwargs):
     """Factory: native C++ loader when available and sufficient, python
     fallback otherwise (same signature, reference
     ``MXNET_REGISTER_IO_ITER(ImageRecordIter)``).  Force a backend with
     ``backend='native'|'python'``."""
     backend = kwargs.pop("backend", "auto")
+    for name_, value in zip(_IRI_POSITIONAL, args):
+        if name_ in kwargs:
+            raise TypeError("ImageRecordIter got multiple values for %r"
+                            % name_)
+        kwargs[name_] = value
+    if len(args) > len(_IRI_POSITIONAL):
+        raise TypeError("too many positional arguments")
+    args = ()
     if backend != "python":
         from ._native import dataloader_lib
 

@@ -93,13 +93,13 @@ _reg_sampler(
     "_sample_exponential", (Param("lam", float, 1.0),),
     lambda p, c: jax.random.exponential(c.rng, p["shape"] or (1,),
                                         p["dtype"]) / p["lam"],
-    aliases=("random_exponential",))
+    aliases=("random_exponential", "exponential"))
 
 _reg_sampler(
     "_sample_poisson", (Param("lam", float, 1.0),),
     lambda p, c: jax.random.poisson(c.rng, p["lam"], p["shape"] or (1,)
                                     ).astype(p["dtype"]),
-    aliases=("random_poisson",))
+    aliases=("random_poisson", "poisson"))
 
 
 def _neg_binomial(p, c):
@@ -112,7 +112,7 @@ def _neg_binomial(p, c):
 
 _reg_sampler("_sample_negbinomial",
              (Param("k", int, 1), Param("p", float, 1.0)),
-             _neg_binomial, aliases=("random_negative_binomial",))
+             _neg_binomial, aliases=("random_negative_binomial", "negative_binomial"))
 
 
 def _gen_neg_binomial(p, c):
@@ -127,4 +127,5 @@ def _gen_neg_binomial(p, c):
 _reg_sampler("_sample_gennegbinomial",
              (Param("mu", float, 1.0), Param("alpha", float, 1.0)),
              _gen_neg_binomial,
-             aliases=("random_generalized_negative_binomial",))
+             aliases=("random_generalized_negative_binomial",
+                      "generalized_negative_binomial"))

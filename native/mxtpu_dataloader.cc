@@ -144,7 +144,7 @@ void Resize(const Image &src, int nh, int nw, Image *dst) {
 // ------------------------------------------------------------- loader
 struct Loader {
   int fd = -1;
-  std::vector<std::pair<uint64_t, uint32_t>> records;  // offset, payload len
+  std::vector<uint64_t> records;  // logical-record start offsets
   std::vector<uint32_t> order;
   size_t cursor = 0;
 
@@ -259,9 +259,9 @@ struct Loader {
         start = pos;
         in_multi = (cflag == 1);
         if (cflag == 0)
-          records.emplace_back(start, 0);
+          records.push_back(start);
       } else if ((cflag == 3) && in_multi) {
-        records.emplace_back(start, 0);
+        records.push_back(start);
         in_multi = false;
       }
       pos += 8 + length + ((4 - (length & 3)) & 3);
@@ -407,7 +407,7 @@ void *mxt_loader_create(const char *rec_path, int batch, int channels,
   // shard for data parallelism (num_parts/part_index contract)
   if (num_parts > 1) {
     size_t n = L->records.size() / num_parts;
-    std::vector<std::pair<uint64_t, uint32_t>> shard(
+    std::vector<uint64_t> shard(
         L->records.begin() + part_index * n,
         L->records.begin() + (part_index + 1) * n);
     L->records.swap(shard);
@@ -448,11 +448,17 @@ int mxt_loader_next(void *h, float *data, float *label) {
   uint32_t epoch_seed = L->seed * 2654435761u + uint32_t(L->epoch);
   L->ParallelFor(L->batch, [&, n](int i) {
     size_t idx = L->order[(L->cursor + i) % n];  // wrap-pad to epoch start
-    std::vector<uint8_t> payload;
-    if (!L->ReadRecord(L->records[idx].first, &payload) ||
-        !L->LoadOne(payload, epoch_seed + uint32_t(idx) * 2246822519u,
-                    data + size_t(i) * plane,
-                    label + size_t(i) * L->label_width)) {
+    bool ok = false;
+    try {
+      std::vector<uint8_t> payload;
+      ok = L->ReadRecord(L->records[idx], &payload) &&
+           L->LoadOne(payload, epoch_seed + uint32_t(idx) * 2246822519u,
+                      data + size_t(i) * plane,
+                      label + size_t(i) * L->label_width);
+    } catch (const std::exception &) {
+      ok = false;  // corrupt header driving a huge alloc etc.
+    }
+    if (!ok) {
       std::memset(data + size_t(i) * plane, 0, plane * sizeof(float));
       std::memset(label + size_t(i) * L->label_width, 0,
                   L->label_width * sizeof(float));
