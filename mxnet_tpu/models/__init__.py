@@ -18,9 +18,10 @@ from . import resnet
 from . import inception_bn
 from . import inception_v3
 from . import lstm_lm
+from . import transformer
 
 __all__ = ["get_symbol", "mlp", "lenet", "alexnet", "vgg", "resnet",
-           "inception_bn", "inception_v3", "lstm_lm"]
+           "inception_bn", "inception_v3", "lstm_lm", "transformer"]
 
 _BUILDERS = {
     "mlp": mlp.get_symbol,
@@ -28,6 +29,8 @@ _BUILDERS = {
     "alexnet": alexnet.get_symbol,
     "inception-bn": inception_bn.get_symbol,
     "inception-v3": inception_v3.get_symbol,
+    "transformer": transformer.get_symbol,
+    "gpt": transformer.get_symbol,
 }
 
 

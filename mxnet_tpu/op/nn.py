@@ -269,11 +269,31 @@ def _pool_infer_shape(p, in_shapes):
 # Activations
 @register("Activation",
           params_spec=(Param("act_type", str, required=True,
-                             enum=("relu", "sigmoid", "tanh", "softrelu")),),
+                             enum=("relu", "sigmoid", "tanh", "softrelu",
+                                   "gelu")),),
           hint="activation")
 def _activation(p, c, a):
     return {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
-            "tanh": jnp.tanh, "softrelu": jax.nn.softplus}[p["act_type"]](a)
+            "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+            "gelu": jax.nn.gelu}[p["act_type"]](a)
+
+
+@register("LayerNorm",
+          params_spec=(Param("axis", int, -1),
+                       Param("eps", float, 1e-5)),
+          input_names=("data", "gamma", "beta"),
+          hint="layernorm")
+def _layer_norm(p, c, data, gamma, beta):
+    """Layer normalization over one axis with learned scale/shift.
+    (Transformer-era addition; the reference's nearest op is
+    ``InstanceNorm``, ``src/operator/instance_norm-inl.h``.)"""
+    ax = p["axis"]
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    normed = (data - mean) * jax.lax.rsqrt(var + p["eps"])
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    return normed * gamma.reshape(shape) + beta.reshape(shape)
 
 
 @register("LeakyReLU",
@@ -776,7 +796,16 @@ def _bilinear_resize(x, s):
     return jax.image.resize(x, (n, ch, h * s, w * s), method="bilinear")
 
 
+def _ln_infer_shape(p, in_shapes):
+    dshape = in_shapes[0]
+    if dshape is None or 0 in dshape:
+        return None
+    n = dshape[p["axis"]]
+    return [tuple(dshape), (n,), (n,)], [tuple(dshape)], []
+
+
 # registry fixups: attach custom bidirectional shape inference
+_reg_mod.get("LayerNorm").infer_shape = _ln_infer_shape
 _reg_mod.get("FullyConnected").infer_shape = _fc_infer_shape
 _reg_mod.get("Convolution").infer_shape = _conv_infer_shape
 alias("Convolution_v1", "Convolution")

@@ -374,3 +374,19 @@ def test_lrn():
     check_symbolic_forward(sym, {"x": a}, [expect])
     check_numeric_gradient(sym, {"x": a}, numeric_eps=1e-2,
                            rtol=0.05, atol=1e-3)
+
+
+def test_layer_norm():
+    rng = np.random.RandomState(5)
+    a = rng.rand(4, 6).astype("f") * 3 + 1
+    g = rng.rand(6).astype("f")
+    b = rng.rand(6).astype("f")
+    mean = a.mean(-1, keepdims=True)
+    var = a.var(-1, keepdims=True)
+    expect = (a - mean) / np.sqrt(var + 1e-5) * g + b
+    x, ga, be = (mx.sym.Variable(n) for n in ("x", "g", "b"))
+    sym = mx.sym.LayerNorm(x, ga, be)
+    check_symbolic_forward(sym, {"x": a, "g": g, "b": b}, [expect],
+                           rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(sym, {"x": a, "g": g, "b": b},
+                           numeric_eps=1e-2, rtol=0.06, atol=1e-2)
