@@ -12,13 +12,21 @@ one device Mesh + sharding annotations, with XLA inserting the collectives.
                          ONE jitted XLA computation (BASELINE north star)
   * :mod:`ring_attention` — sequence-parallel blockwise attention over an
                          ICI ring (long-context first-class support)
+  * :mod:`pipeline`    — GPipe-style SPMD pipeline over a ``pipe`` axis
+                         (AD derives the backward schedule)
+  * :mod:`moe`         — expert parallelism: dispatch/combine MoE over an
+                         ``expert`` axis
 """
 from .mesh import (Mesh, get_mesh, current_mesh, data_parallel_mesh,
                    make_mesh)
 from .collectives import global_allreduce, barrier
 from .trainer import Trainer
 from .ring_attention import ring_attention, ring_attention_sharded
+from .pipeline import pipeline_apply
+from .moe import moe_init, moe_apply, moe_shardings, moe_load_balance_loss
 
 __all__ = ["Mesh", "get_mesh", "current_mesh", "data_parallel_mesh",
            "make_mesh", "global_allreduce", "barrier", "Trainer",
-           "ring_attention", "ring_attention_sharded"]
+           "ring_attention", "ring_attention_sharded", "pipeline_apply",
+           "moe_init", "moe_apply", "moe_shardings",
+           "moe_load_balance_loss"]

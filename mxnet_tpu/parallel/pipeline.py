@@ -1,0 +1,98 @@
+"""Pipeline parallelism over a ``pipe`` mesh axis.
+
+Greenfield relative to the reference (its only model-splitting tool was
+per-layer device placement with cross-device activation copies,
+``example/model-parallel-lstm``).  The TPU-native design is a GPipe-style
+SPMD pipeline written as ordinary traceable ops: every device runs the
+same program, holds one stage's parameters (leading stage dim sharded
+over ``pipe``), and activations hop stage→stage with ``ppermute``.
+Because the schedule is plain jax (a ``lax.scan`` over ticks), **reverse-
+mode AD derives the backward pipeline automatically** — no hand-written
+1F1B schedule.
+
+Microbatching fills the pipeline: with ``n_micro`` microbatches and
+``S`` stages, the scan runs ``n_micro + S - 1`` ticks; device ``s``
+computes microbatch ``t - s`` at tick ``t``.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec
+
+__all__ = ["pipeline_apply"]
+
+
+def _shift_right(x, axis_name):
+    """Send to the next stage; stage 0 receives stage S-1's output (which
+    the schedule ignores)."""
+    n = lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def pipeline_apply(stage_fn, stage_params, inputs, mesh, axis="pipe"):
+    """Run ``stage_fn`` as an S-stage pipeline.
+
+    Parameters
+    ----------
+    stage_fn : (params_one_stage, x) -> y
+        one stage's computation; activations keep shape ``(mb, d)``.
+    stage_params : pytree
+        every leaf has leading dim S (one slice per stage); sharded over
+        ``mesh[axis]`` by this function.
+    inputs : (n_micro, mb, d)
+        microbatched input (replicated).
+    Returns ``(n_micro, mb, d)`` outputs (replicated).
+
+    Differentiable: wrap in ``jax.grad``/``value_and_grad`` freely.
+    """
+    S = mesh.shape[axis]
+    n_micro = inputs.shape[0]
+
+    param_spec = jax.tree.map(lambda _: PartitionSpec(axis), stage_params)
+
+    def per_device(params, xs):
+        # params: leading dim 1 (this stage's slice); xs: full microbatches
+        params = jax.tree.map(lambda p: p[0], params)
+        stage = lax.axis_index(axis)
+        mb_shape = xs.shape[1:]
+
+        state = jnp.zeros(mb_shape, xs.dtype)       # current activation
+        outs = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, outs = carry
+            # stage 0 ingests microbatch t (when valid); others take the
+            # activation handed over from the previous stage
+            feed = jnp.where(t < n_micro, xs[jnp.minimum(t, n_micro - 1)],
+                             jnp.zeros(mb_shape, xs.dtype))
+            x = jnp.where(stage == 0, feed, state)
+            y = stage_fn(params, x)
+            # the last stage completed microbatch t-(S-1) this tick
+            done_idx = t - (S - 1)
+            is_last = stage == S - 1
+            valid = (done_idx >= 0) & (done_idx < n_micro) & is_last
+            outs = lax.cond(
+                valid,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y.astype(o.dtype), jnp.maximum(done_idx, 0), 0),
+                lambda o: o, outs)
+            state = _shift_right(y, axis)
+            return (state, outs), None
+
+        (_, outs), _ = lax.scan(tick, (state, outs),
+                                jnp.arange(n_micro + S - 1))
+        # only the last stage holds real outputs; broadcast to all
+        outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(outs, axis)
+
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(param_spec, PartitionSpec()),
+        out_specs=PartitionSpec(),
+        check_vma=False)
+    return fn(stage_params, inputs)
