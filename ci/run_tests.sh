@@ -1,0 +1,30 @@
+#!/bin/bash
+# CI driver (the reference's Jenkinsfile matrix, SURVEY §2.6/§4):
+#   1. native build
+#   2. unit suite on the virtual 8-device CPU mesh
+#   3. multi-process distributed tests (local launcher)
+#   4. cpu-vs-tpu consistency (skips cleanly without a TPU)
+#   5. driver entry points (bench JSON + multichip dryrun)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "=== native build ==="
+make -C native
+
+echo "=== unit tests (virtual 8-device CPU mesh) ==="
+python -m pytest tests/ -x -q
+
+echo "=== distributed (2-worker local launcher) ==="
+python tools/launch.py -n 2 --launcher local -- \
+    python tests/nightly/dist_sync_kvstore.py
+python tools/launch.py -n 2 --launcher local -- \
+    python tests/nightly/dist_mlp.py
+
+echo "=== cpu-vs-tpu consistency ==="
+python tests/nightly/consistency.py
+
+echo "=== driver entry points ==="
+python __graft_entry__.py
+python bench.py
+
+echo "CI OK"

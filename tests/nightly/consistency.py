@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Cross-backend consistency: the reference re-runs its op tests on GPU
+and asserts CPU/GPU executors match (``tests/python/gpu/
+test_operator_gpu.py`` + ``check_consistency``, SURVEY §4).  The TPU
+analog: the same symbol bound on host-CPU jax and on the TPU backend
+must produce matching outputs and input gradients.
+
+Run standalone (needs the TPU default backend visible):
+
+    python tests/nightly/consistency.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.test_utils import check_consistency
+
+    if jax.devices()[0].platform not in ("tpu", "axon"):
+        print("SKIP: no TPU backend visible")
+        return 0
+
+    np.random.seed(0)
+    x = mx.sym.Variable("data")
+    w = mx.sym.Variable("w")
+    cases = [
+        ("fc", mx.sym.FullyConnected(x, num_hidden=8), (4, 16)),
+        ("conv", mx.sym.Convolution(x, kernel=(3, 3), num_filter=4,
+                                    pad=(1, 1)), (2, 3, 8, 8)),
+        ("pool", mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
+                                pool_type="max"), (2, 3, 8, 8)),
+        ("bn", mx.sym.BatchNorm(x, fix_gamma=False), (4, 3, 5, 5)),
+        ("act", mx.sym.Activation(x, act_type="tanh"), (4, 7)),
+        ("softmax", mx.sym.softmax(x), (4, 9)),
+        ("ln", mx.sym.LayerNorm(x, mx.sym.Variable("g"),
+                                mx.sym.Variable("b")), (4, 6)),
+        ("elemwise", mx.sym.sqrt(mx.sym.abs(x) + 1.0) * 2.0, (3, 5)),
+        ("dot", mx.sym.dot(x, w), {"data": (4, 6), "w": (6, 3)}),
+        ("reduce", mx.sym.sum(x, axis=1), (3, 7)),
+        ("transpose", mx.sym.transpose(x, axes=(1, 0)), (3, 4)),
+        ("embed+take", mx.sym.Embedding(x, input_dim=11, output_dim=5),
+         (4, 3)),
+        ("lrn", mx.sym.LRN(x, nsize=3), (2, 6, 4, 4)),
+        ("upsample", mx.sym.UpSampling(x, scale=2, sample_type="nearest"),
+         (1, 2, 4, 4)),
+    ]
+    failures = []
+    for name, sym, shape in cases:
+        shapes = shape if isinstance(shape, dict) else {"data": shape}
+        ctx_list = [dict(ctx=mx.cpu(), **shapes),
+                    dict(ctx=mx.tpu(), **shapes)]
+        grad_req = "null" if name == "embed+take" else "write"
+        try:
+            check_consistency(sym, ctx_list, grad_req=grad_req, tol=2e-2)
+            print("OK  %s" % name)
+        except Exception as e:                       # noqa: BLE001
+            failures.append((name, e))
+            print("FAIL %s: %s" % (name, e))
+    if failures:
+        return 1
+    print("cpu-vs-tpu consistency: %d/%d ops match" % (len(cases),
+                                                       len(cases)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,3 +32,17 @@ def test_dist_mlp_two_workers():
     assert res.returncode == 0, res.stdout + res.stderr
     assert res.stdout.count("params identical") == 2, \
         res.stdout + res.stderr
+
+
+def test_cpu_tpu_consistency():
+    """Cross-backend consistency suite (the reference's GPU re-run trick,
+    SURVEY §4) — runs standalone so it sees both backends."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)       # let the default backend load
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tests", "nightly",
+                                      "consistency.py")],
+        capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert ("consistency: 14/14" in res.stdout or
+            "SKIP" in res.stdout), res.stdout
