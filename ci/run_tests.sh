@@ -12,7 +12,8 @@ echo "=== native build ==="
 make -C native
 
 echo "=== unit tests (virtual 8-device CPU mesh) ==="
-python -m pytest tests/ -x -q
+# test_dist.py re-runs the launcher/consistency scripts below
+python -m pytest tests/ -x -q --ignore=tests/test_dist.py
 
 echo "=== distributed (2-worker local launcher) ==="
 python tools/launch.py -n 2 --launcher local -- \

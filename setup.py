@@ -20,8 +20,10 @@ class BuildWithNative(build_py):
             try:
                 subprocess.run(["make", "-C", src], check=True)
             except Exception as e:     # noqa: BLE001
+                import sys
                 print("warning: native build failed (%s); "
-                      "pure-python fallbacks will be used" % e)
+                      "pure-python fallbacks will be used" % e,
+                      file=sys.stderr)
         super().run()
 
 
