@@ -1,0 +1,48 @@
+// C++ deploy example: load a checkpoint and classify one input through
+// the RAII wrapper (reference cpp-package examples, deploy path).
+//
+// Build:
+//   g++ -std=c++17 -I../include predict_example.cc \
+//       -L../../mxnet_tpu/lib -lmxtpu_c_api \
+//       -Wl,-rpath,'$ORIGIN/../../mxnet_tpu/lib' -o predict_example
+// Run (model saved by e.g. tests/test_c_api.py):
+//   PYTHONPATH=../.. ./predict_example <model-prefix> <epoch>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+
+#include "../include/mxtpu_predict.hpp"
+
+static std::string slurp(const std::string &path) {
+  std::ifstream f(path, std::ios::binary);
+  if (!f) throw mxtpu::Error("cannot open " + path);
+  std::ostringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+int main(int argc, char **argv) {
+  const std::string prefix = argc > 1 ? argv[1] : "model";
+  const int epoch = argc > 2 ? std::atoi(argv[2]) : 0;
+  char params_name[64];
+  std::snprintf(params_name, sizeof params_name, "-%04d.params", epoch);
+  try {
+    mxtpu::Predictor pred(slurp(prefix + "-symbol.json"),
+                          slurp(prefix + params_name),
+                          {{"data", {2, 8}}});
+    std::vector<float> x(16);
+    for (size_t i = 0; i < x.size(); ++i)
+      x[i] = static_cast<float>(i) / 16.0f - 0.5f;
+    pred.set_input("data", x);
+    pred.forward();
+    auto out = pred.get_output(0);
+    auto shape = pred.output_shape(0);
+    std::cout << "output [" << shape[0] << ", " << shape[1] << "]:";
+    for (float v : out) std::cout << " " << v;
+    std::cout << std::endl;
+    return 0;
+  } catch (const mxtpu::Error &e) {
+    std::cerr << "error: " << e.what() << std::endl;
+    return 1;
+  }
+}

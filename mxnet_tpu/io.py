@@ -932,3 +932,58 @@ def ImageRecordIter(*args, **kwargs):
 
 
 ImageRecordIter_v1 = ImageRecordIter
+
+
+class ImageDetRecordIter(PyImageRecordIter):
+    """Detection variant: variable-length ground-truth labels per image
+    (reference ``src/io/iter_image_det_recordio.cc``): each record's
+    label block holds N objects × ``object_width`` floats; the iterator
+    pads every sample to ``label_pad_width`` floats with
+    ``label_pad_value`` and yields labels shaped
+    ``(batch, label_pad_width // object_width, object_width)`` — the
+    layout ``MultiBoxTarget`` consumes."""
+
+    def __init__(self, *args, label_pad_width=0, label_pad_value=-1.0,
+                 object_width=5, **kwargs):
+        self.label_pad_width = int(label_pad_width)
+        self.label_pad_value = float(label_pad_value)
+        self.object_width = int(object_width)
+        if self.label_pad_width <= 0:
+            raise MXNetError("label_pad_width (total floats, a multiple "
+                             "of object_width) is required")
+        if self.label_pad_width % self.object_width:
+            raise MXNetError("label_pad_width must be a multiple of "
+                             "object_width")
+        kwargs.setdefault("label_width", self.label_pad_width)
+        super().__init__(*args, **kwargs)
+
+    def _decode_one(self, raw):
+        header, img = _recordio.unpack_img(raw)
+        lab = np.full((self.label_pad_width,), self.label_pad_value,
+                      np.float32)
+        if header.flag > 0:
+            src = np.asarray(header.label, np.float32).ravel()
+            if len(src) > self.label_pad_width:
+                raise MXNetError(
+                    "record %s carries %d label floats > label_pad_width="
+                    "%d; raise label_pad_width to the dataset's max "
+                    "object count" % (header.id, len(src),
+                                      self.label_pad_width))
+            lab[:len(src)] = src
+        # flag == 0 (scalar label / empty list): a background-only image —
+        # every slot stays at label_pad_value, no phantom object
+        return self._augment(img), lab
+
+    @property
+    def provide_label(self):
+        w = self.object_width
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.label_pad_width // w, w))]
+
+    def next(self):
+        batch = super().next()
+        w = self.object_width
+        lab = batch.label[0]
+        batch.label = [lab.reshape((self.batch_size,
+                                    self.label_pad_width // w, w))]
+        return batch

@@ -97,6 +97,52 @@ extern "C" {
 
 const char *MXGetLastError() { return g_last_error.c_str(); }
 
+int MXGetVersion(int *out) {
+  // MAJOR*10000 + MINOR*100 + PATCH, reference c_api.h MXGetVersion
+  *out = 100;  // 0.1.0
+  return 0;
+}
+
+// List every registered operator name (reference MXListAllOpNames,
+// c_api.h).  Returned pointers stay valid until the next call.
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  EnsurePython();
+  Gil gil;
+  // per-thread return store (the reference's MXAPIThreadLocalEntry
+  // pattern): a second call from another thread must not free the
+  // strings this caller is still reading
+  thread_local std::vector<std::string> names;
+  thread_local std::vector<const char *> ptrs;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.op.registry");
+  if (mod == nullptr) return Fail("import registry");
+  PyObject *lst = PyObject_CallMethod(mod, "list_ops", nullptr);
+  Py_DECREF(mod);
+  if (lst == nullptr) return Fail("list_ops");
+  Py_ssize_t n = PySequence_Size(lst);
+  if (n < 0) {
+    Py_DECREF(lst);
+    return Fail("list_ops returned a non-sequence");
+  }
+  names.clear();
+  ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PySequence_GetItem(lst, i);
+    const char *s = item != nullptr ? PyUnicode_AsUTF8(item) : nullptr;
+    if (s == nullptr) {
+      Py_XDECREF(item);
+      Py_DECREF(lst);
+      return Fail("non-string op name");
+    }
+    names.emplace_back(s);
+    Py_DECREF(item);
+  }
+  Py_DECREF(lst);
+  for (const auto &s : names) ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(ptrs.size());
+  *out_array = ptrs.data();
+  return 0;
+}
+
 int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
                  int param_size, int dev_type, int dev_id,
                  mx_uint num_input_nodes, const char **input_keys,

@@ -235,3 +235,52 @@ def test_native_loader_multipart_record(tmp_path):
     # image decoded successfully (not the zero-filled failure path)
     assert it._lib.mxt_loader_failures(it._handle) == 0
     assert abs(float(b.data[0].asnumpy().mean()) - 128.0) < 3.0
+
+
+def test_image_det_record_iter(tmp_path):
+    """Detection iterator: variable-object labels pad to a fixed
+    (batch, num_obj, width) block (reference iter_image_det_recordio)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import ImageDetRecordIter
+    from PIL import Image
+    import io as pio
+    rec_path = str(tmp_path / "det.rec")
+    rng = np.random.RandomState(0)
+    rec = recordio.MXRecordIO(rec_path, "w")
+    objs_per_img = [1, 3, 2, 0]
+    for i, n_obj in enumerate(objs_per_img):
+        img = Image.fromarray(rng.randint(0, 255, (24, 24, 3),
+                                          dtype=np.uint8))
+        buf = pio.BytesIO()
+        img.save(buf, format="JPEG")
+        label = []
+        for j in range(n_obj):
+            label += [float(j), 0.1, 0.1, 0.5, 0.5]
+        label = np.array(label, np.float32)   # empty => flag 0 record
+        rec.write(recordio.pack(
+            recordio.IRHeader(len(label), label, i, 0), buf.getvalue()))
+    rec.close()
+    it = ImageDetRecordIter(path_imgrec=rec_path, data_shape=(3, 20, 20),
+                            batch_size=4, label_pad_width=15)
+    b = next(iter(it))
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (4, 3, 5)
+    assert lab[1, 2, 0] == 2.0          # third object of image 1
+    assert lab[0, 1, 0] == -1.0         # padding
+    assert (lab[3] == -1.0).all()       # zero-object image: all padding
+    assert b.data[0].shape == (4, 3, 20, 20)
+    # over-capacity records must error, not silently truncate
+    import pytest
+    rec2 = str(tmp_path / "big.rec")
+    w = recordio.MXRecordIO(rec2, "w")
+    big = np.arange(20, dtype=np.float32)
+    img = Image.fromarray(np.zeros((8, 8, 3), np.uint8))
+    buf2 = pio.BytesIO()
+    img.save(buf2, format="JPEG")
+    w.write(recordio.pack(recordio.IRHeader(len(big), big, 0, 0),
+                          buf2.getvalue()))
+    w.close()
+    it2 = ImageDetRecordIter(path_imgrec=rec2, data_shape=(3, 8, 8),
+                             batch_size=1, label_pad_width=15)
+    with pytest.raises(Exception, match="label_pad_width"):
+        next(iter(it2))
