@@ -37,7 +37,10 @@ int main(int argc, char **argv) {
     pred.forward();
     auto out = pred.get_output(0);
     auto shape = pred.output_shape(0);
-    std::cout << "output [" << shape[0] << ", " << shape[1] << "]:";
+    std::cout << "output [";
+    for (size_t i = 0; i < shape.size(); ++i)
+      std::cout << (i ? ", " : "") << shape[i];
+    std::cout << "]:";
     for (float v : out) std::cout << " " << v;
     std::cout << std::endl;
     return 0;

@@ -969,6 +969,11 @@ class ImageDetRecordIter(PyImageRecordIter):
                     "%d; raise label_pad_width to the dataset's max "
                     "object count" % (header.id, len(src),
                                       self.label_pad_width))
+            if len(src) % self.object_width:
+                raise MXNetError(
+                    "record %s carries %d label floats, not a multiple "
+                    "of object_width=%d — malformed ground truth"
+                    % (header.id, len(src), self.object_width))
             lab[:len(src)] = src
         # flag == 0 (scalar label / empty list): a background-only image —
         # every slot stays at label_pad_value, no phantom object

@@ -277,4 +277,9 @@ class DataParallelExecutorGroup(object):
 
     def install_monitor(self, mon):
         for exe in self.execs:
-            exe.install_monitor(mon)
+            if hasattr(mon, "install"):
+                # a Monitor object: registers its stat_helper tap and
+                # tracks the executor (reference monitor.py:56)
+                mon.install(exe)
+            else:
+                exe.install_monitor(mon)

@@ -45,9 +45,11 @@ class Monitor(object):
         self.stat_helper = stat_helper
 
     def install(self, exe):
-        """Install the tap on an executor (reference ``monitor.py:56``)."""
+        """Install the tap on an executor (reference ``monitor.py:56``);
+        idempotent per executor."""
         exe.install_monitor(self.stat_helper)
-        self.exes.append(exe)
+        if exe not in self.exes:
+            self.exes.append(exe)
 
     def tic(self):
         """Start collecting stats for this batch if due

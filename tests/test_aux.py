@@ -1,0 +1,126 @@
+"""Auxiliary-subsystem coverage (SURVEY §5): Monitor taps, profiler
+Chrome-JSON dump, visualization, FeedForward legacy API, callbacks, LR
+schedulers."""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _tiny_net():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _tiny_data(n=64, d=6, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(0, 1, (n, d)).astype("f")
+    Y = (X @ rng.normal(0, 1, (d, classes))).argmax(1).astype("f")
+    return X, Y
+
+
+def test_monitor_taps_outputs():
+    """Monitor sees per-op outputs during forward (reference
+    ``monitor.py:33-65`` via the executor monitor callback)."""
+    seen = []
+    mon = mx.monitor.Monitor(1, stat_func=lambda x: x,
+                             pattern=".*output.*")
+    X, Y = _tiny_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(_tiny_net())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=True)
+    rows = mon.toc()
+    assert rows, "monitor recorded nothing"
+    names = {name for _, name, _ in rows}
+    assert any("fc" in n for n in names), names
+
+
+def test_profiler_chrome_json(tmp_path):
+    fname = str(tmp_path / "prof.json")
+    mx.profiler.profiler_set_config(mode="all", filename=fname)
+    mx.profiler.profiler_set_state("run")
+    x = mx.nd.ones((8, 8))
+    (x + x).asnumpy()
+    ex = _tiny_net().simple_bind(mx.tpu(), data=(4, 6),
+                                 softmax_label=(4,))
+    ex.forward()
+    mx.profiler.profiler_set_state("stop")
+    out = mx.profiler.dump_profile()
+    events = json.load(open(out))["traceEvents"]
+    assert any(e.get("ph") == "B" for e in events)
+    assert any(e.get("ph") == "M" for e in events)   # process_name rows
+
+
+def test_visualization_summary(capsys):
+    mx.viz.print_summary(_tiny_net(), shape={"data": (1, 6)})
+    out = capsys.readouterr().out
+    assert "fc" in out and "Total params" in out
+
+
+def test_feedforward_legacy_api():
+    X, Y = _tiny_data()
+    model = mx.model.FeedForward(_tiny_net(), num_epoch=8,
+                                 optimizer="sgd", learning_rate=0.3,
+                                 initializer=mx.init.Xavier(),
+                                 numpy_batch_size=16)
+    model.fit(X=X, y=Y)
+    preds = model.predict(X)
+    assert preds.shape == (64, 4)
+    acc = float((preds.argmax(1) == Y).mean())
+    assert acc > 0.8, acc
+
+
+def test_checkpoint_callback_roundtrip(tmp_path):
+    prefix = str(tmp_path / "cb")
+    X, Y = _tiny_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(_tiny_net())
+    mod.fit(it, num_epoch=2, initializer=mx.init.Xavier(),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0002.params")
+    sym, arg_p, aux_p = mx.model.load_checkpoint(prefix, 2)
+    ref_args, _ = mod.get_params()
+    np.testing.assert_allclose(arg_p["fc_weight"].asnumpy(),
+                               ref_args["fc_weight"].asnumpy())
+
+
+def test_speedometer_and_log_metric(caplog):
+    sp = mx.callback.Speedometer(batch_size=16, frequent=2)
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([0., 1.])],
+                  [mx.nd.array([[0.9, 0.1], [0.1, 0.9]])])
+
+    class P:
+        def __init__(self, i):
+            self.epoch, self.nbatch, self.eval_metric = 0, i, metric
+            self.locals = None
+
+    with caplog.at_level(logging.INFO):
+        for i in range(1, 5):
+            sp(P(i))
+    assert any("Speed" in r.message for r in caplog.records)
+
+
+def test_lr_schedulers():
+    # reference semantics: decay applies once num_update EXCEEDS the step
+    fs = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5)
+    fs.base_lr = 1.0
+    assert fs(0) == 1.0
+    assert fs(10) == 1.0
+    assert fs(11) == 0.5
+    assert fs(21) == 0.25
+    ms = mx.lr_scheduler.MultiFactorScheduler(step=[5, 15], factor=0.1)
+    ms.base_lr = 1.0
+    assert ms(0) == 1.0
+    assert abs(ms(6) - 0.1) < 1e-12
+    assert abs(ms(16) - 0.01) < 1e-12
