@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Char-level LSTM language model + greedy sampling (reference
+``example/rnn/char-rnn.ipynb`` / ``char_lstm.py``): train on a text
+corpus, then generate text one character at a time by feeding the
+LSTM states back through a single-step executor — the classic RNN
+inference pattern (state outputs re-fed as state inputs).
+
+Reads ``--corpus`` if it exists; otherwise trains on a built-in pattern
+text so the example runs offline, and asserts the sampler reproduces
+the pattern.
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_vocab(text):
+    chars = sorted(set(text))
+    return {c: i for i, c in enumerate(chars)}, chars
+
+
+def train_symbol(seq_len, vocab_size, num_hidden, num_embed, cell):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    cell.reset()
+    outputs, _ = cell.unroll(seq_len, inputs=embed, merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, num_hidden))
+    pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size, name="pred")
+    label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label, name="softmax")
+
+
+def step_symbol(vocab_size, num_hidden, num_embed, cell):
+    """One-timestep graph: (data (1,1), states...) -> (probs, states...)"""
+    data = mx.sym.Variable("data")
+    embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                             output_dim=num_embed, name="embed")
+    embed = mx.sym.Reshape(embed, shape=(0, -1))
+    cell.reset()
+    states = cell.begin_state(func=mx.sym.Variable)
+    out, new_states = cell(embed, states)
+    pred = mx.sym.FullyConnected(out, num_hidden=vocab_size, name="pred")
+    prob = mx.sym.softmax(pred)
+    return mx.sym.Group([prob] + list(new_states)), states
+
+
+def sample(cell, arg_params, vocab, chars, seed_text, length,
+           num_hidden, num_embed):
+    """Greedy generation with explicit state feedback."""
+    sym, state_syms = step_symbol(len(vocab), num_hidden, num_embed, cell)
+    state_names = [s.name for s in state_syms]
+    shapes = {"data": (1, 1)}
+    shapes.update({n: (1, num_hidden) for n in state_names})
+    ex = sym.simple_bind(mx.tpu(), grad_req="null", **shapes)
+    for name, arr in ex.arg_dict.items():
+        if name in arg_params:
+            arr[:] = arg_params[name].asnumpy()
+    states = {n: np.zeros((1, num_hidden), "f") for n in state_names}
+    out = list(seed_text)
+    idx = None
+    for ch in seed_text:
+        idx = vocab[ch]
+        feeds = {"data": np.array([[idx]], "f")}
+        feeds.update(states)
+        outs = ex.forward(**feeds)
+        states = {n: outs[i + 1].asnumpy()
+                  for i, n in enumerate(state_names)}
+    for _ in range(length):
+        idx = int(outs[0].asnumpy().argmax())
+        out.append(chars[idx])
+        feeds = {"data": np.array([[idx]], "f")}
+        feeds.update(states)
+        outs = ex.forward(**feeds)
+        states = {n: outs[i + 1].asnumpy()
+                  for i, n in enumerate(state_names)}
+    return "".join(out)
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description="char-level LSTM LM",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("--corpus", type=str, default="data/input.txt")
+    parser.add_argument("--seq-len", type=int, default=32)
+    parser.add_argument("--num-hidden", type=int, default=128)
+    parser.add_argument("--num-embed", type=int, default=32)
+    parser.add_argument("--num-epochs", type=int, default=10)
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--sample-len", type=int, default=60)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    if os.path.exists(args.corpus):
+        text = open(args.corpus).read()
+    else:
+        logging.warning("%s not found; using a built-in pattern corpus",
+                        args.corpus)
+        text = ("the quick brown fox jumps over the lazy dog. " * 200)
+    vocab, chars = build_vocab(text)
+    ids = np.array([vocab[c] for c in text], np.int32)
+
+    T = args.seq_len
+    n = (len(ids) - 1) // T
+    X = ids[:n * T].reshape(n, T).astype("f")
+    Y = ids[1:n * T + 1].reshape(n, T).astype("f")
+
+    cell = mx.rnn.LSTMCell(num_hidden=args.num_hidden, prefix="lstm_")
+    sym = train_symbol(T, len(vocab), args.num_hidden, args.num_embed,
+                       cell)
+    it = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size, shuffle=True)
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=args.num_epochs, optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.34),
+            eval_metric=mx.metric.Perplexity(None),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       20))
+    arg_params, _ = mod.get_params()
+
+    seed = "the quick "
+    text_out = sample(cell, arg_params, vocab, chars, seed,
+                      args.sample_len, args.num_hidden, args.num_embed)
+    logging.info("sampled: %r", text_out)
+    if not os.path.exists(args.corpus):
+        # on the pattern corpus the continuation is deterministic
+        expect = ("the quick brown fox jumps over the lazy dog. " * 3)
+        ok = text_out[:40] == expect[:40]
+        logging.info("pattern reproduction: %s", "OK" if ok else "FAIL")
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

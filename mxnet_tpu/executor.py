@@ -151,27 +151,22 @@ class Executor:
 
         from . import profiler as _prof
         if self._monitor is not None:
+            # per-op tapped evaluation (runs the forward once eagerly to
+            # feed the monitor; training then falls through to the shared
+            # compiled-vjp path below, like the reference keeps backward
+            # working while the monitor disables bulk exec)
             def cb(name, val):
                 self._monitor(name, NDArray(val))
             outs, new_aux = self._prog._eval(
                 list(arg_vals), list(aux_vals), key, is_train, monitor=cb)
-            if is_train:
-                # keep training usable under the tap: build the vjp too
-                # (the reference likewise keeps backward working while
-                # the monitor disables bulk exec)
-                fn = self._prog.jitted(True)
-                (outs, new_aux), vjp = jax.vjp(
-                    lambda a, x: fn(a, x, key), arg_vals, aux_vals)
-                self._vjp = vjp
-            else:
-                self._vjp = None
-        elif is_train:
+            self._vjp = None
+        if is_train:
             with _prof.record_scope("Forward", str(self._ctx)):
                 fn = self._prog.jitted(True)
                 (outs, new_aux), vjp = jax.vjp(
                     lambda a, x: fn(a, x, key), arg_vals, aux_vals)
             self._vjp = vjp
-        else:
+        elif self._monitor is None:
             with _prof.record_scope("Forward", str(self._ctx)):
                 fn = self._prog.jitted(False)
                 outs, new_aux = fn(arg_vals, aux_vals, key)

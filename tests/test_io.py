@@ -284,3 +284,14 @@ def test_image_det_record_iter(tmp_path):
                              batch_size=1, label_pad_width=15)
     with pytest.raises(Exception, match="label_pad_width"):
         next(iter(it2))
+    # malformed ground truth: not a multiple of object_width
+    rec3 = str(tmp_path / "odd.rec")
+    w = recordio.MXRecordIO(rec3, "w")
+    odd = np.arange(7, dtype=np.float32)
+    w.write(recordio.pack(recordio.IRHeader(len(odd), odd, 0, 0),
+                          buf2.getvalue()))
+    w.close()
+    it3 = ImageDetRecordIter(path_imgrec=rec3, data_shape=(3, 8, 8),
+                             batch_size=1, label_pad_width=15)
+    with pytest.raises(Exception, match="object_width"):
+        next(iter(it3))
