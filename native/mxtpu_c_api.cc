@@ -103,6 +103,14 @@ int MXGetVersion(int *out) {
   return 0;
 }
 
+// Graceful shutdown notification (reference MXNotifyShutdown /
+// src/initialize.cc): drops the last-error buffer; the XLA runtime and
+// host engine clean up via normal teardown.
+int MXNotifyShutdown() {
+  g_last_error.clear();
+  return 0;
+}
+
 // List every registered operator name (reference MXListAllOpNames,
 // c_api.h).  Returned pointers stay valid until the next call.
 int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {

@@ -18,6 +18,9 @@
 //
 // Build: native/Makefile -> mxnet_tpu/lib/libmxtpu_runtime.so
 
+#include <execinfo.h>
+#include <signal.h>
+
 #include <algorithm>
 #include <atomic>
 #include <condition_variable>
@@ -38,6 +41,35 @@
 extern "C" {
 typedef void (*mxt_fn_t)(void *arg);
 }
+
+namespace {
+// segfault backtrace logger (reference src/initialize.cc:14-30):
+// installed once at library load so native-side crashes print a stack
+// instead of dying silently under the interpreter.
+void SegfaultLogger(int sig) {
+  // async-signal-safe only: write() + backtrace_symbols_fd (libgcc is
+  // pre-loaded at install time so backtrace() does no lazy dlopen here)
+  static const char msg[] = "\nmxtpu native: fatal signal, backtrace:\n";
+  ssize_t unused = write(2, msg, sizeof(msg) - 1);
+  (void)unused;
+  void *stack[16];
+  int n = backtrace(stack, 16);
+  backtrace_symbols_fd(stack, n, 2);
+  signal(sig, SIG_DFL);
+  raise(sig);
+}
+
+struct InstallCrashHandler {
+  InstallCrashHandler() {
+    if (getenv("MXTPU_NO_SEGV_HANDLER") == nullptr) {
+      void *stack[1];
+      backtrace(stack, 1);  // pre-load libgcc outside the handler
+      signal(SIGSEGV, SegfaultLogger);
+      signal(SIGBUS, SegfaultLogger);
+    }
+  }
+} g_install_crash_handler;
+}  // namespace
 
 namespace mxtpu {
 
