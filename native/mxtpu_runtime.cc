@@ -20,6 +20,7 @@
 
 #include <execinfo.h>
 #include <signal.h>
+#include <unistd.h>
 
 #include <algorithm>
 #include <atomic>
@@ -46,6 +47,9 @@ namespace {
 // segfault backtrace logger (reference src/initialize.cc:14-30):
 // installed once at library load so native-side crashes print a stack
 // instead of dying silently under the interpreter.
+void (*g_prev_segv)(int) = nullptr;
+void (*g_prev_bus)(int) = nullptr;
+
 void SegfaultLogger(int sig) {
   // async-signal-safe only: write() + backtrace_symbols_fd (libgcc is
   // pre-loaded at install time so backtrace() does no lazy dlopen here)
@@ -55,6 +59,12 @@ void SegfaultLogger(int sig) {
   void *stack[16];
   int n = backtrace(stack, 16);
   backtrace_symbols_fd(stack, n, 2);
+  // chain to whatever was installed before us (python faulthandler,
+  // embedding-app crash reporters), else die with the default action
+  void (*prev)(int) = sig == SIGBUS ? g_prev_bus : g_prev_segv;
+  if (prev != nullptr && prev != SIG_IGN && prev != SIG_DFL) {
+    prev(sig);
+  }
   signal(sig, SIG_DFL);
   raise(sig);
 }
@@ -64,8 +74,8 @@ struct InstallCrashHandler {
     if (getenv("MXTPU_NO_SEGV_HANDLER") == nullptr) {
       void *stack[1];
       backtrace(stack, 1);  // pre-load libgcc outside the handler
-      signal(SIGSEGV, SegfaultLogger);
-      signal(SIGBUS, SegfaultLogger);
+      g_prev_segv = signal(SIGSEGV, SegfaultLogger);
+      g_prev_bus = signal(SIGBUS, SegfaultLogger);
     }
   }
 } g_install_crash_handler;
