@@ -83,11 +83,10 @@ def main():
         fake = gen.get_outputs()[0]
         real = mx.nd.array(real_batch(rng, B))
 
-        # 1) discriminator on fake (label 0) — keep input grads for G
+        # 1) discriminator on fake (label 0)
         disc.forward(mx.io.DataBatch(data=[fake], label=[zeros]),
                      is_train=True)
         disc.backward()
-        grad_fake_d = [g.copyto(mx.tpu()) for g in disc.get_input_grads()]
         disc.update()
 
         # 2) discriminator on real (label 1)

@@ -47,8 +47,7 @@ namespace {
 // segfault backtrace logger (reference src/initialize.cc:14-30):
 // installed once at library load so native-side crashes print a stack
 // instead of dying silently under the interpreter.
-void (*g_prev_segv)(int) = nullptr;
-void (*g_prev_bus)(int) = nullptr;
+struct sigaction g_prev_segv, g_prev_bus;
 
 void SegfaultLogger(int sig) {
   // async-signal-safe only: write() + backtrace_symbols_fd (libgcc is
@@ -59,13 +58,15 @@ void SegfaultLogger(int sig) {
   void *stack[16];
   int n = backtrace(stack, 16);
   backtrace_symbols_fd(stack, n, 2);
-  // chain to whatever was installed before us (python faulthandler,
-  // embedding-app crash reporters), else die with the default action
-  void (*prev)(int) = sig == SIGBUS ? g_prev_bus : g_prev_segv;
-  if (prev != nullptr && prev != SIG_IGN && prev != SIG_DFL) {
-    prev(sig);
+  // restore whatever was installed before us (python faulthandler,
+  // embedding-app crash reporters — possibly SA_SIGINFO handlers, which
+  // must be re-entered by the kernel, not called as void(*)(int)) and
+  // re-raise so it runs; default action if there was none
+  const struct sigaction *prev = sig == SIGBUS ? &g_prev_bus
+                                               : &g_prev_segv;
+  if (sigaction(sig, prev, nullptr) != 0) {
+    signal(sig, SIG_DFL);
   }
-  signal(sig, SIG_DFL);
   raise(sig);
 }
 
@@ -74,8 +75,14 @@ struct InstallCrashHandler {
     if (getenv("MXTPU_NO_SEGV_HANDLER") == nullptr) {
       void *stack[1];
       backtrace(stack, 1);  // pre-load libgcc outside the handler
-      g_prev_segv = signal(SIGSEGV, SegfaultLogger);
-      g_prev_bus = signal(SIGBUS, SegfaultLogger);
+      struct sigaction act;
+      memset(&act, 0, sizeof(act));
+      act.sa_handler = SegfaultLogger;
+      sigemptyset(&act.sa_mask);
+      if (sigaction(SIGSEGV, &act, &g_prev_segv) != 0)
+        g_prev_segv.sa_handler = SIG_DFL;
+      if (sigaction(SIGBUS, &act, &g_prev_bus) != 0)
+        g_prev_bus.sa_handler = SIG_DFL;
     }
   }
 } g_install_crash_handler;

@@ -1,0 +1,89 @@
+"""Tooling tests: im2rec list/pack round trip, parse_log, launcher env
+contract, op-doc generation (reference ``tools/``)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, **kw):
+    return subprocess.run([sys.executable] + args, capture_output=True,
+                          text=True, cwd=_ROOT, timeout=240, **kw)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for cls in ("a", "b"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.fromarray(rng.randint(0, 255, (32, 40, 3),
+                                        dtype=np.uint8)).save(
+                str(d / ("%s%d.jpg" % (cls, i))))
+    prefix = str(tmp_path / "data")
+    r = _run(["tools/im2rec.py", prefix, str(tmp_path), "--list",
+              "--recursive"])
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".lst")
+    r = _run(["tools/im2rec.py", prefix, str(tmp_path), "--resize", "24"])
+    assert r.returncode == 0, r.stderr
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 20, 20), batch_size=3)
+    batch = next(iter(it))
+    labels = sorted(batch.label[0].asnumpy().tolist())
+    assert set(labels) <= {0.0, 1.0}
+    assert batch.data[0].shape == (3, 3, 20, 20)
+
+
+def test_parse_log():
+    log = ("Epoch[0] Batch [20]\tSpeed: 111.5 samples/sec\t"
+           "accuracy=0.5\n"
+           "Epoch[0] Train-accuracy=0.91\n"
+           "Epoch[0] Time cost=4.2\n"
+           "Epoch[0] Validation-accuracy=0.88\n")
+    r = _run(["tools/parse_log.py", "--format", "none"], input=log)
+    assert r.returncode == 0, r.stderr
+    line = r.stdout.strip().splitlines()[-1]
+    cells = line.split("\t")
+    assert cells[0] == "0"
+    assert float(cells[1]) == 0.91
+    assert float(cells[2]) == 0.88
+    assert abs(float(cells[3]) - 111.5) < 1e-6
+
+
+def test_launch_local_env_contract(tmp_path):
+    out = str(tmp_path / "w")
+    r = _run(["tools/launch.py", "-n", "2", "--launcher", "local", "--",
+              sys.executable, "-c",
+              "import os; open(%r + os.environ['MXTPU_PROCESS_ID'], 'w')"
+              ".write(os.environ['MXTPU_NUM_PROCESSES'])" % out])
+    assert r.returncode == 0, r.stderr
+    assert open(out + "0").read() == "2"
+    assert open(out + "1").read() == "2"
+
+
+def test_launch_local_fails_fast():
+    r = _run(["tools/launch.py", "-n", "2", "--launcher", "local", "--",
+              sys.executable, "-c",
+              "import os, sys, time\n"
+              "rank = int(os.environ['MXTPU_PROCESS_ID'])\n"
+              "sys.exit(3) if rank == 1 else time.sleep(120)"])
+    # a crashing worker must tear down the sleeper well before 120s
+    # (the 240s _run timeout would otherwise trip)
+    assert r.returncode != 0
+
+
+def test_gen_op_docs(tmp_path):
+    path = str(tmp_path / "ops.md")
+    r = _run(["tools/gen_op_docs.py", path])
+    assert r.returncode == 0, r.stderr
+    text = open(path).read()
+    assert "## FullyConnected" in text
+    assert "**required**" in text
