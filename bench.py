@@ -22,7 +22,13 @@ def main():
     from mxnet_tpu import models
     from mxnet_tpu.parallel import Trainer
 
-    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    try:
+        on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    except Exception as e:      # backend/tunnel failure: still emit a line
+        print("TPU backend unavailable (%s); falling back to CPU" % e,
+              file=sys.stderr)
+        jax.config.update("jax_platforms", "cpu")
+        on_tpu = False
     batch = 256 if on_tpu else 16
     image = 224 if on_tpu else 64
     steps = 20 if on_tpu else 3

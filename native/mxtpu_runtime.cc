@@ -64,7 +64,15 @@ void SegfaultLogger(int sig) {
   // re-raise so it runs; default action if there was none
   const struct sigaction *prev = sig == SIGBUS ? &g_prev_bus
                                                : &g_prev_segv;
-  if (sigaction(sig, prev, nullptr) != 0) {
+  // only chain to a real previous handler; SIG_IGN (or a failed
+  // restore) must become SIG_DFL or an ignored re-raise would loop on
+  // the faulting instruction forever
+  bool has_prev = (prev->sa_flags & SA_SIGINFO) != 0
+                      ? prev->sa_sigaction != nullptr
+                      : (prev->sa_handler != SIG_IGN &&
+                         prev->sa_handler != SIG_DFL &&
+                         prev->sa_handler != nullptr);
+  if (!has_prev || sigaction(sig, prev, nullptr) != 0) {
     signal(sig, SIG_DFL);
   }
   raise(sig);
