@@ -1,13 +1,18 @@
 """Driver benchmark: ResNet-50 ImageNet training throughput (img/s) on one
-chip, synthetic data (the reference's ``--benchmark 1`` mode), bf16 compute
-with f32 master weights, whole train step (fwd+bwd+SGD-momentum update) as
-one jitted XLA computation.
+chip through the **Module path** — the same code path as
+``examples/image-classification/train_imagenet.py`` (``Module.fit``'s inner
+loop: ``forward(is_train=True)``, ``update()``, ``update_metric``), with
+``kvstore=dist_sync_tpu`` and synthetic data (the reference's
+``--benchmark 1`` mode).  The Module auto-routes onto the fused Trainer:
+fwd+bwd+allreduce+SGD-momentum update as ONE jitted XLA computation, bf16
+compute with f32 master weights.
 
 Baseline: the reference's best published single-device number — ResNet-50
 batch-32 training on P100, 181.53 img/s (``docs/how_to/perf.md:151-183``,
 copied in BASELINE.md).  Prints ONE JSON line.
 """
 import json
+import os
 import sys
 import time
 
@@ -17,10 +22,11 @@ BASELINE_IMG_S = 181.53  # reference single-P100 ResNet-50 train, batch 32
 
 
 def main():
+    # fuse the Module step on every backend (the default for tpu contexts)
+    os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
     import jax
     import mxnet_tpu as mx
-    from mxnet_tpu import models
-    from mxnet_tpu.parallel import Trainer
+    from mxnet_tpu import io, models
 
     try:
         on_tpu = jax.devices()[0].platform in ("tpu", "axon")
@@ -34,35 +40,46 @@ def main():
     steps = 20 if on_tpu else 3
 
     sym = models.get_symbol("resnet-50", num_classes=1000)
-    trainer = Trainer(sym, mx.optimizer.SGD(learning_rate=0.1, momentum=0.9),
-                      compute_dtype="bfloat16")
-    trainer.bind(data_shapes={"data": (batch, 3, image, image)},
-                 label_shapes={"softmax_label": (batch,)})
-    trainer.init_params(mx.init.Xavier(factor_type="in", magnitude=2.0))
+    ctx = mx.tpu() if on_tpu else mx.cpu()
+    mod = mx.mod.Module(context=ctx, symbol=sym, compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch, 3, image, image))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    kv = mx.kvstore.create("dist_sync_tpu")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / batch})
+    assert mod._trainer is not None, "bench must measure the fused path"
 
     rng = np.random.RandomState(0)
     x = rng.normal(0, 1, (batch, 3, image, image)).astype(np.float32)
     y = rng.randint(0, 1000, (batch,)).astype(np.float32)
     # stage once in HBM (synthetic-data mode measures compute, not PCIe)
-    batch_dict = {"data": mx.nd.array(x), "softmax_label": mx.nd.array(y)}
+    data_batch = io.DataBatch(data=[mx.nd.array(x)],
+                              label=[mx.nd.array(y)], pad=0)
+    metric = mx.metric.create("acc")
 
-    def sync(outs):
-        # on the axon remote backend ``block_until_ready`` does not
-        # actually block; a device→host transfer is the only honest
-        # completion barrier, so fetch one scalar of the output
-        np.asarray(outs[0].data[:1, :1])
+    def one_step():
+        # Module.fit inner loop (base_module.py fit): fwd+update+metric.
+        # Metrics accumulate on-device (no per-step host sync).
+        mod.forward(data_batch, is_train=True)
+        mod.update()
+        mod.update_metric(metric, data_batch.label)
 
-    # warmup (compile)
-    for _ in range(2):
-        outs = trainer.step(batch_dict)
-    sync(outs)
+    for _ in range(2):       # warmup (compile)
+        one_step()
+    metric.get()
+    metric.reset()
 
-    # steps chain through the donated parameter state, so one scalar
-    # fetch at the end forces the whole timed sequence to completion
     t0 = time.perf_counter()
     for _ in range(steps):
-        outs = trainer.step(batch_dict)
-    sync(outs)
+        one_step()
+    # metric.get() drains the device accumulator, which depends on every
+    # step's outputs — the honest completion barrier on the axon backend,
+    # where block_until_ready does not actually block
+    metric.get()
     elapsed = time.perf_counter() - t0
 
     img_s = batch * steps / elapsed

@@ -1,64 +1,161 @@
-"""Evaluation metrics (reference ``python/mxnet/metric.py:22-462``).
+"""Evaluation metrics — TPU-native accumulation.
 
-Metrics run host-side on numpy — they sit outside the compiled train step and
-force a device sync only when ``.asnumpy()`` pulls outputs, mirroring the
-reference where ``update_metric`` triggers ``WaitToRead``.
+API parity with the reference metric module (``python/mxnet/metric.py``:
+EvalMetric / CompositeEvalMetric / Accuracy / TopKAccuracy / F1 /
+Perplexity / MAE / MSE / RMSE / CrossEntropy / CustomMetric / np /
+create), redesigned for an async accelerator:
+
+The reference pulls every batch's outputs to the host (``asnumpy`` →
+engine ``WaitToRead``) and loops in Python.  Here each metric's per-batch
+statistic is a small **jitted device computation** returning two scalars
+``(sum, count)`` that are folded into device-resident accumulators.  No
+host transfer happens per batch, so ``update_metric`` never stalls the
+dispatch pipeline; the single device→host sync is deferred to ``get()``.
+``CustomMetric`` (user numpy code) is the documented exception — it must
+fetch.
 """
 from __future__ import annotations
 
 import math
 
-import numpy
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
 
 from .base import MXNetError, string_types
 from .ndarray import NDArray
 
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
+           "F1", "Perplexity", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "CustomMetric", "np", "create", "check_label_shapes"]
+
 
 def check_label_shapes(labels, preds, shape=0):
-    if shape == 0:
-        label_shape, pred_shape = len(labels), len(preds)
-    else:
-        label_shape, pred_shape = labels.shape, preds.shape
-    if label_shape != pred_shape:
-        raise ValueError("Shape of labels {} does not match shape of "
-                         "predictions {}".format(label_shape, pred_shape))
+    """Raise if label/pred lists (or arrays, ``shape=1``) disagree."""
+    a = len(labels) if shape == 0 else labels.shape
+    b = len(preds) if shape == 0 else preds.shape
+    if a != b:
+        raise ValueError(
+            "labels %s vs predictions %s mismatch" % (str(a), str(b)))
+
+
+def _raw(x):
+    """Device view of a metric input without copying."""
+    if isinstance(x, NDArray):
+        return x.data
+    return jnp.asarray(onp.asarray(x))
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@jax.jit
+def _fold(acc_s, acc_n, s, n):
+    return acc_s + s, acc_n + n
 
 
 class EvalMetric(object):
-    """Base metric accumulating (sum_metric, num_inst)."""
+    """Base class: device-scalar ``(sum, count)`` accumulation.
+
+    Subclasses implement ``_stat(label, pred) -> (sum, count)`` in pure
+    ``jnp``; the base jits it per subclass and streams the scalars into
+    device accumulators.  ``sum_metric`` / ``num_inst`` remain visible as
+    host numbers (synced lazily for API parity).
+    """
 
     def __init__(self, name, num=None):
         self.name = name
         self.num = num
+        self._jit_stat = None
+        self._gather = False
         self.reset()
 
-    def update(self, labels, preds):
+    # -- accumulation ---------------------------------------------------
+    def _stat(self, label, pred):
         raise NotImplementedError()
+
+    def update(self, labels, preds):
+        if self.num is not None:
+            raise NotImplementedError(
+                "multi-output metrics (num=%d) must override update()"
+                % self.num)
+        labels, preds = _as_list(labels), _as_list(preds)
+        check_label_shapes(labels, preds)
+        if self._jit_stat is None:
+            self._jit_stat = jax.jit(self._stat)
+        for label, pred in zip(labels, preds):
+            label, pred = _raw(label), _raw(pred)
+            if self._gather:
+                label, pred = onp.asarray(label), onp.asarray(pred)
+            try:
+                s, n = self._jit_stat(label, pred)
+            except ValueError:
+                # label and prediction live on different device sets
+                # (e.g. mesh-sharded outputs vs a host-fed label): gather
+                # to host once and keep doing so for this metric
+                self._gather = True
+                s, n = self._jit_stat(onp.asarray(label),
+                                      onp.asarray(pred))
+            self._acc = _fold(self._acc[0], self._acc[1], s, n)
 
     def reset(self):
         if self.num is None:
-            self.num_inst = 0
-            self.sum_metric = 0.0
+            # f32 sums are exact for integer counts < 2^24; ``get`` (hit
+            # by Speedometer every few dozen batches) drains to the host
+            # float accumulator long before that
+            self._acc = (jnp.float32(0.0), jnp.int32(0))
+            self._host = [0.0, 0]
         else:
-            self.num_inst = [0] * self.num
-            self.sum_metric = [0.0] * self.num
+            self._acc = None
+            self._host = [[0.0] * self.num, [0] * self.num]
 
+    def _drain(self):
+        """Fold device accumulators into the host mirror (the one sync)."""
+        if self.num is None and self._acc is not None:
+            s, n = self._acc
+            self._host[0] += float(s)
+            self._host[1] += int(n)
+            self._acc = (jnp.zeros_like(s), jnp.zeros_like(n))
+
+    # host-visible counters (reference attribute parity)
+    @property
+    def sum_metric(self):
+        self._drain()
+        return self._host[0]
+
+    @sum_metric.setter
+    def sum_metric(self, v):
+        self._drain()
+        self._host[0] = v
+
+    @property
+    def num_inst(self):
+        self._drain()
+        return self._host[1]
+
+    @num_inst.setter
+    def num_inst(self, v):
+        self._drain()
+        self._host[1] = v
+
+    # -- results --------------------------------------------------------
     def get(self):
+        self._drain()
         if self.num is None:
-            if self.num_inst == 0:
-                return (self.name, float("nan"))
-            return (self.name, self.sum_metric / self.num_inst)
+            total, count = self._host
+            return (self.name,
+                    total / count if count else float("nan"))
         names = ["%s_%d" % (self.name, i) for i in range(self.num)]
-        values = [x / y if y != 0 else float("nan")
-                  for x, y in zip(self.sum_metric, self.num_inst)]
-        return (names, values)
+        vals = [s / n if n else float("nan")
+                for s, n in zip(self._host[0], self._host[1])]
+        return (names, vals)
 
     def get_name_value(self):
         name, value = self.get()
-        if not isinstance(name, list):
-            name = [name]
-        if not isinstance(value, list):
-            value = [value]
+        name = name if isinstance(name, list) else [name]
+        value = value if isinstance(value, list) else [value]
         return list(zip(name, value))
 
     def __str__(self):
@@ -66,14 +163,11 @@ class EvalMetric(object):
 
 
 class CompositeEvalMetric(EvalMetric):
-    """Manage multiple metrics as one (reference ``metric.py:86``)."""
+    """Several metrics driven as one (reference ``metric.py:86``)."""
 
-    def __init__(self, **kwargs):
+    def __init__(self, metrics=None):
+        self.metrics = list(metrics or [])
         super().__init__("composite")
-        try:
-            self.metrics = kwargs["metrics"]
-        except KeyError:
-            self.metrics = []
 
     def add(self, metric):
         self.metrics.append(metric)
@@ -82,247 +176,183 @@ class CompositeEvalMetric(EvalMetric):
         try:
             return self.metrics[index]
         except IndexError:
-            return ValueError("Metric index {} is out of range 0 and {}".format(
-                index, len(self.metrics)))
+            return ValueError("Metric index %d out of range [0, %d)" %
+                              (index, len(self.metrics)))
 
     def update(self, labels, preds):
-        for metric in self.metrics:
-            metric.update(labels, preds)
+        for m in self.metrics:
+            m.update(labels, preds)
 
     def reset(self):
-        try:
-            for metric in self.metrics:
-                metric.reset()
-        except AttributeError:
-            pass
+        for m in getattr(self, "metrics", []):
+            m.reset()
+        super().reset()
 
     def get(self):
-        names = []
-        results = []
-        for metric in self.metrics:
-            result = metric.get()
-            names.append(result[0])
-            results.append(result[1])
-        return (names, results)
+        pairs = [m.get() for m in self.metrics]
+        return ([p[0] for p in pairs], [p[1] for p in pairs])
 
 
+# ----------------------------------------------------------------------
 class Accuracy(EvalMetric):
+    """Classification accuracy; argmaxes class-prob rows when pred shape
+    differs from the label shape."""
+
     def __init__(self):
         super().__init__("accuracy")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred = pred_label.asnumpy()
-            if pred.shape != label.shape:
-                pred_label = numpy.argmax(pred, axis=1)
-            else:
-                pred_label = pred
-            label = label.asnumpy().astype("int32")
-            pred_label = numpy.asarray(pred_label).astype("int32")
-            check_label_shapes(label, pred_label, shape=1)
-            self.sum_metric += (pred_label.flat == label.flat).sum()
-            self.num_inst += len(pred_label.flat)
+    def _stat(self, label, pred):
+        if pred.shape != label.shape:
+            pred = jnp.argmax(pred, axis=1)
+        hits = (pred.astype(jnp.int32).ravel() ==
+                label.astype(jnp.int32).ravel())
+        return hits.sum().astype(jnp.float32), jnp.int32(hits.size)
 
 
 class TopKAccuracy(EvalMetric):
-    def __init__(self, **kwargs):
-        super().__init__("top_k_accuracy")
-        try:
-            self.top_k = kwargs["top_k"]
-        except KeyError:
-            self.top_k = 1
-        if self.top_k <= 1:
-            raise MXNetError("Please use Accuracy if top_k is no more than 1")
-        self.name += "_%d" % self.top_k
+    """Label within the k most probable classes."""
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred_label in zip(labels, preds):
-            pred_label = numpy.argsort(pred_label.asnumpy().astype("float32"), axis=1)
-            label = label.asnumpy().astype("int32")
-            check_label_shapes(label, pred_label)
-            num_samples = pred_label.shape[0]
-            num_dims = len(pred_label.shape)
-            if num_dims == 1:
-                self.sum_metric += (pred_label.flat == label.flat).sum()
-            elif num_dims == 2:
-                num_classes = pred_label.shape[1]
-                top_k = min(num_classes, self.top_k)
-                for j in range(top_k):
-                    self.sum_metric += (
-                        pred_label[:, num_classes - 1 - j].flat == label.flat).sum()
-            self.num_inst += num_samples
+    def __init__(self, **kwargs):
+        top_k = kwargs.get("top_k", 1)
+        if top_k <= 1:
+            raise MXNetError("Please use Accuracy if top_k is no more than 1")
+        self.top_k = top_k
+        super().__init__("top_k_accuracy_%d" % top_k)
+
+    def _stat(self, label, pred):
+        if pred.ndim == 1:
+            hits = (pred.astype(jnp.int32) == label.astype(jnp.int32))
+            return hits.sum().astype(jnp.float32), jnp.int32(label.shape[0])
+        k = min(self.top_k, pred.shape[1])
+        _, top = jax.lax.top_k(pred, k)
+        hits = (top == label.astype(jnp.int32)[:, None]).any(axis=1)
+        return hits.sum().astype(jnp.float32), jnp.int32(label.shape[0])
 
 
 class F1(EvalMetric):
-    """Binary-classification F1 (reference ``metric.py:183``)."""
+    """Binary F1, scored per batch and averaged over batches (matching
+    reference semantics).  Labels must be {0, 1}; the reference's
+    host-side >2-class check is not replicated on-device."""
 
     def __init__(self):
         super().__init__("f1")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            pred = pred.asnumpy()
-            label = label.asnumpy().astype("int32")
-            pred_label = numpy.argmax(pred, axis=1)
-            check_label_shapes(label, pred_label)
-            if len(numpy.unique(label)) > 2:
-                raise ValueError("F1 currently only supports binary classification.")
-            true_positives, false_positives, false_negatives = 0., 0., 0.
-            for y_pred, y_true in zip(pred_label, label):
-                if y_pred == 1 and y_true == 1:
-                    true_positives += 1.
-                elif y_pred == 1 and y_true == 0:
-                    false_positives += 1.
-                elif y_pred == 0 and y_true == 1:
-                    false_negatives += 1.
-            if true_positives + false_positives > 0:
-                precision = true_positives / (true_positives + false_positives)
-            else:
-                precision = 0.
-            if true_positives + false_negatives > 0:
-                recall = true_positives / (true_positives + false_negatives)
-            else:
-                recall = 0.
-            if precision + recall > 0:
-                f1_score = 2 * precision * recall / (precision + recall)
-            else:
-                f1_score = 0.
-            self.sum_metric += f1_score
-            self.num_inst += 1
+    def _stat(self, label, pred):
+        y = jnp.argmax(pred, axis=1).astype(jnp.int32)
+        t = label.astype(jnp.int32).ravel()
+        tp = jnp.sum((y == 1) & (t == 1)).astype(jnp.float32)
+        fp = jnp.sum((y == 1) & (t == 0)).astype(jnp.float32)
+        fn = jnp.sum((y == 0) & (t == 1)).astype(jnp.float32)
+        precision = jnp.where(tp + fp > 0, tp / (tp + fp), 0.0)
+        recall = jnp.where(tp + fn > 0, tp / (tp + fn), 0.0)
+        f1 = jnp.where(precision + recall > 0,
+                       2 * precision * recall / (precision + recall), 0.0)
+        return f1, jnp.int32(1)
 
 
 class Perplexity(EvalMetric):
-    """Perplexity with optional padding-label masking
-    (reference ``metric.py:230-269``)."""
+    """exp(mean negative log prob of the target), with an optional
+    ignored padding label."""
 
     def __init__(self, ignore_label, axis=-1):
-        super().__init__("Perplexity")
         self.ignore_label = ignore_label
         self.axis = axis
+        super().__init__("Perplexity")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        loss = 0.
-        num = 0
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy().astype("int32").reshape((-1,))
-            pred = pred.asnumpy()
-            if pred.ndim > 2:
-                pred = pred.reshape((-1, pred.shape[-1]))
-            probs = pred[numpy.arange(label.shape[0]), label]
-            if self.ignore_label is not None:
-                ignore = (label == self.ignore_label).astype(probs.dtype)
-                num -= int(numpy.sum(ignore))
-                probs = probs * (1 - ignore) + ignore
-            loss -= numpy.sum(numpy.log(numpy.maximum(1e-10, probs)))
-            num += label.shape[0]
-        self.sum_metric += loss
-        self.num_inst += num
+    def _stat(self, label, pred):
+        lab = label.astype(jnp.int32).ravel()
+        if pred.ndim > 2:
+            pred = pred.reshape((-1, pred.shape[-1]))
+        probs = jnp.take_along_axis(pred, lab[:, None], axis=1)[:, 0]
+        if self.ignore_label is not None:
+            keep = lab != self.ignore_label
+            probs = jnp.where(keep, probs, 1.0)
+            count = keep.sum().astype(jnp.int32)
+        else:
+            count = jnp.int32(lab.shape[0])
+        loss = -jnp.sum(jnp.log(jnp.maximum(probs, 1e-10)))
+        return loss.astype(jnp.float32), count
 
     def get(self):
-        if self.num_inst == 0:
+        self._drain()
+        total, count = self._host
+        if not count:
             return (self.name, float("nan"))
-        return (self.name, math.exp(self.sum_metric / self.num_inst))
+        return (self.name, math.exp(total / count))
 
 
-class MAE(EvalMetric):
+class _Regression(EvalMetric):
+    """Shared shape handling for per-batch regression scores."""
+
+    def _stat(self, label, pred):
+        if label.ndim == 1:
+            label = label[:, None]
+        return self._score(label.astype(pred.dtype), pred), jnp.int32(1)
+
+
+class MAE(_Regression):
     def __init__(self):
         super().__init__("mae")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.abs(label - pred).mean()
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return jnp.abs(label - pred).mean().astype(jnp.float32)
 
 
-class MSE(EvalMetric):
+class MSE(_Regression):
     def __init__(self):
         super().__init__("mse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += ((label - pred) ** 2.0).mean()
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return jnp.square(label - pred).mean().astype(jnp.float32)
 
 
-class RMSE(EvalMetric):
+class RMSE(_Regression):
     def __init__(self):
         super().__init__("rmse")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            if len(label.shape) == 1:
-                label = label.reshape(label.shape[0], 1)
-            self.sum_metric += numpy.sqrt(((label - pred) ** 2.0).mean())
-            self.num_inst += 1
+    def _score(self, label, pred):
+        return jnp.sqrt(jnp.square(label - pred).mean()).astype(jnp.float32)
 
 
 class CrossEntropy(EvalMetric):
     def __init__(self, eps=1e-8):
-        super().__init__("cross-entropy")
         self.eps = eps
+        super().__init__("cross-entropy")
 
-    def update(self, labels, preds):
-        check_label_shapes(labels, preds)
-        for label, pred in zip(labels, preds):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            label = label.ravel()
-            if label.shape[0] != pred.shape[0]:
-                raise MXNetError("label and prediction batch size mismatch")
-            prob = pred[numpy.arange(label.shape[0]), numpy.int64(label)]
-            self.sum_metric += (-numpy.log(prob + self.eps)).sum()
-            self.num_inst += label.shape[0]
+    def _stat(self, label, pred):
+        lab = label.astype(jnp.int32).ravel()
+        picked = jnp.take_along_axis(pred, lab[:, None], axis=1)[:, 0]
+        loss = -jnp.sum(jnp.log(picked + self.eps))
+        return loss.astype(jnp.float32), jnp.int32(lab.shape[0])
 
 
 class CustomMetric(EvalMetric):
-    """Metric from a ``feval(label, pred)`` function
-    (reference ``metric.py:362``)."""
+    """Metric from a user ``feval(label, pred)`` numpy function.  This is
+    the one metric that must fetch outputs to the host every update."""
 
     def __init__(self, feval, name=None, allow_extra_outputs=False):
         if name is None:
             name = feval.__name__
-            if name.find("<") != -1:
+            if "<" in name:
                 name = "custom(%s)" % name
-        super().__init__(name)
         self._feval = feval
         self._allow_extra_outputs = allow_extra_outputs
+        super().__init__(name)
 
     def update(self, labels, preds):
+        labels, preds = _as_list(labels), _as_list(preds)
         if not self._allow_extra_outputs:
             check_label_shapes(labels, preds)
-        for pred, label in zip(preds, labels):
-            label = label.asnumpy()
-            pred = pred.asnumpy()
-            reval = self._feval(label, pred)
-            if isinstance(reval, tuple):
-                (sum_metric, num_inst) = reval
-                self.sum_metric += sum_metric
-                self.num_inst += num_inst
-            else:
-                self.sum_metric += reval
-                self.num_inst += 1
+        for label, pred in zip(labels, preds):
+            result = self._feval(onp.asarray(_raw(label)),
+                                 onp.asarray(_raw(pred)))
+            s, n = result if isinstance(result, tuple) else (result, 1)
+            self._host[0] += s
+            self._host[1] += n
 
 
 def np(numpy_feval, name=None, allow_extra_outputs=False):
-    """Wrap a numpy eval function into a CustomMetric
-    (reference ``metric.py:399``)."""
+    """Wrap a numpy eval function into a CustomMetric."""
 
     def feval(label, pred):
         return numpy_feval(label, pred)
@@ -331,28 +361,30 @@ def np(numpy_feval, name=None, allow_extra_outputs=False):
     return CustomMetric(feval, name, allow_extra_outputs)
 
 
+_REGISTRY = {
+    "acc": Accuracy, "accuracy": Accuracy,
+    "ce": CrossEntropy, "cross-entropy": CrossEntropy,
+    "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
+    "top_k_accuracy": TopKAccuracy, "perplexity": Perplexity,
+}
+
+
 def create(metric, **kwargs):
-    """Create a metric from name / function / instance / list."""
+    """Create a metric from a name, callable, instance, or list."""
     if callable(metric):
         return CustomMetric(metric)
     if isinstance(metric, EvalMetric):
         return metric
     if isinstance(metric, list):
-        composite_metric = CompositeEvalMetric()
-        for child_metric in metric:
-            composite_metric.add(create(child_metric, **kwargs))
-        return composite_metric
+        out = CompositeEvalMetric()
+        for m in metric:
+            out.add(create(m, **kwargs))
+        return out
     if not isinstance(metric, string_types):
-        raise TypeError("metric should be either an instance of EvalMetric, "
-                        "a string, a callable or a list")
-    metrics = {
-        "acc": Accuracy, "accuracy": Accuracy, "ce": CrossEntropy,
-        "f1": F1, "mae": MAE, "mse": MSE, "rmse": RMSE,
-        "top_k_accuracy": TopKAccuracy, "perplexity": Perplexity,
-        "cross-entropy": CrossEntropy,
-    }
+        raise TypeError("metric should be an EvalMetric, a str, a "
+                        "callable or a list")
     try:
-        return metrics[metric.lower()](**kwargs)
+        return _REGISTRY[metric.lower()](**kwargs)
     except KeyError:
-        raise ValueError("Metric must be either callable or in {}".format(
-            sorted(metrics)))
+        raise ValueError("Metric must be callable or one of %s" %
+                         sorted(_REGISTRY))

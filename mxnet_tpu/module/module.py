@@ -18,6 +18,7 @@ Reference: ``python/mxnet/module/module.py:323-565``.  Two execution paths:
 from __future__ import annotations
 
 import logging
+import os
 import warnings
 
 import numpy as np
@@ -45,8 +46,13 @@ class Module(BaseModule):
 
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging,
-                 context=None, work_load_list=None, fixed_param_names=None):
+                 context=None, work_load_list=None, fixed_param_names=None,
+                 compute_dtype=None):
         super().__init__(logger=logger)
+        # fused-path compute dtype (e.g. "bfloat16" for MXU-rate matmuls
+        # with fp32 master weights); default from MXTPU_COMPUTE_DTYPE
+        self._compute_dtype = compute_dtype or \
+            os.environ.get("MXTPU_COMPUTE_DTYPE") or None
         if context is None:
             context = current_context()
         self._mesh = context if isinstance(context, _JaxMesh) else None
@@ -56,6 +62,11 @@ class Module(BaseModule):
             self._context = [context]
         else:
             self._context = list(context)
+        # fused-path policy: "auto" fuses a single tpu Context onto an
+        # auto-built 1-host mesh (the north-star path: whole train step =
+        # one XLA computation), "always" fuses any single context (used
+        # by the CPU tests), "never" forces the classic executor group
+        self._fused_mode = os.environ.get("MXTPU_MODULE_FUSED", "auto")
         if work_load_list is None:
             work_load_list = [1] * len(self._context)
         assert len(work_load_list) == len(self._context)
@@ -92,6 +103,7 @@ class Module(BaseModule):
         self._trainer = None
         self._staged_batch = None
         self._fused_outputs = None
+        self._auto_fused = False
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -125,6 +137,9 @@ class Module(BaseModule):
         self._data_shapes = None
         self._label_shapes = None
         self._trainer = None
+        if self._auto_fused:
+            self._mesh = None
+            self._auto_fused = False
 
     @property
     def data_names(self):
@@ -258,18 +273,23 @@ class Module(BaseModule):
         else:
             shared_group = None
 
-        if self._mesh is not None and for_training and not inputs_need_grad \
-                and shared_module is None:
+        fused_ok = (for_training and not inputs_need_grad and
+                    shared_module is None and grad_req == "write" and
+                    not self._fixed_param_names and
+                    self._fused_mode != "never")
+        if self._mesh is None and fused_ok and (
+                self._fused_mode == "always" or
+                (len(self._context) == 1 and
+                 self._context[0].device_type == "tpu")):
+            self._mesh = self._auto_mesh()
+            self._auto_fused = True
+        if self._mesh is not None and fused_ok:
             # fused path defers compilation until init_optimizer; here we
             # only infer shapes and allocate host-visible param mirrors
             self._build_param_mirrors()
             return
 
-        self._exec_group = DataParallelExecutorGroup(
-            self._symbol, self._context, self._work_load_list,
-            self._data_shapes, self._label_shapes, self._param_names,
-            for_training, inputs_need_grad, shared_group, logger=self.logger,
-            fixed_param_names=self._fixed_param_names, grad_req=grad_req)
+        self._bind_exec_group(shared_group=shared_group, grad_req=grad_req)
         if shared_module is not None:
             self.params_initialized = True
             self._arg_params = shared_module._arg_params
@@ -286,6 +306,28 @@ class Module(BaseModule):
             self._aux_params = dict(zip(self._aux_names, aux_arrays))
         if shared_module is not None and shared_module.optimizer_initialized:
             self.borrow_optimizer(shared_module)
+
+    def _bind_exec_group(self, shared_group=None, grad_req="write"):
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._work_load_list,
+            self._data_shapes, self._label_shapes, self._param_names,
+            self.for_training, self.inputs_need_grad, shared_group,
+            logger=self.logger, fixed_param_names=self._fixed_param_names,
+            grad_req=grad_req)
+
+    def _auto_mesh(self):
+        """Build a single-host data-parallel mesh over the default
+        backend's local devices (the TPU analog of the reference's
+        context-list data parallelism): as many devices as evenly divide
+        the batch, 1 on a lone chip."""
+        import jax
+        from ..parallel import make_mesh
+        devs = jax.local_devices()
+        batch = self._data_shapes[0].shape[0]
+        n = len(devs)
+        while n > 1 and batch % n != 0:
+            n -= 1
+        return make_mesh({"data": n}, devs[:n])
 
     def _build_param_mirrors(self):
         shapes = {d.name: d.shape for d in self._data_shapes}
@@ -339,21 +381,56 @@ class Module(BaseModule):
         self._optimizer = optimizer
 
         if self._mesh is not None and self._exec_group is None:
-            from ..parallel.trainer import Trainer
-            self._trainer = Trainer(
-                self._symbol, optimizer, data_names=self._data_names,
-                label_names=self._label_names, mesh=self._mesh)
-            self._trainer.bind(
-                data_shapes={d.name: d.shape for d in self._data_shapes},
-                label_shapes={d.name: d.shape
-                              for d in (self._label_shapes or [])})
-            self._trainer.init_params(arg_params=self._arg_params,
-                                      aux_params=self._aux_params,
-                                      force_init=True)
-            self._kvstore = None
-            self._update_on_kvstore = False
-            self.optimizer_initialized = True
-            return
+            from ..kvstore import KVStore as _KVStore
+            from ..kvstore import create as _kv_create
+            if isinstance(kvstore, _KVStore):
+                kv = kvstore
+            elif isinstance(kvstore, str) and "dist" in kvstore:
+                kv = _kv_create(kvstore)
+            else:
+                kv = None
+            if kv is not None and "dist" in kv.type and kv.num_workers > 1 \
+                    and self._auto_fused:
+                # Multi-host with only an auto-built single-host mesh: the
+                # fused step would not sync gradients across hosts.  Fall
+                # back to the classic path, whose KVStoreTPU psum does
+                # (pass an explicit global Mesh to fuse multi-host).
+                self._mesh = None
+                self._trainer = None
+                self._bind_exec_group()
+                self._exec_group.set_params(self._arg_params,
+                                            self._aux_params)
+            else:
+                from ..parallel.trainer import Trainer
+                self._trainer = Trainer(
+                    self._symbol, optimizer, data_names=self._data_names,
+                    label_names=self._label_names, mesh=self._mesh,
+                    compute_dtype=self._compute_dtype)
+                self._trainer.bind(
+                    data_shapes={d.name: d.shape for d in self._data_shapes},
+                    label_shapes={d.name: d.shape
+                                  for d in (self._label_shapes or [])})
+                if kv is not None and "dist" in kv.type \
+                        and kv.num_workers > 1:
+                    # explicit global mesh: psum rides inside the fused
+                    # step; make the starting params identical by
+                    # broadcasting rank 0's init (kvstore_dist.h:63-80)
+                    for name in self._param_names:
+                        kv.init(name, self._arg_params[name])
+                        kv.pull(name, out=self._arg_params[name])
+                    for name in self._aux_names:
+                        kv.init("aux:" + name, self._aux_params[name])
+                        kv.pull("aux:" + name, out=self._aux_params[name])
+                self._trainer.init_params(arg_params=self._arg_params,
+                                          aux_params=self._aux_params,
+                                          force_init=True)
+                self._kvstore = None
+                self._update_on_kvstore = False
+                self.optimizer_initialized = True
+                if self._preload_opt_states is not None:
+                    self.load_optimizer_states(self._preload_opt_states)
+                    self._preload_opt_states = None
+                return
 
         kvstore, update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
@@ -399,9 +476,28 @@ class Module(BaseModule):
                 self._staged_batch = batch
                 self._fused_outputs = None
             else:
+                self._ensure_trainer()
                 self._fused_outputs = self._trainer.forward(batch)
             return
         self._exec_group.forward(data_batch, is_train)
+
+    def _ensure_trainer(self):
+        """Fused-path forward before init_optimizer (e.g. ``score`` on a
+        freshly bound module): compile a trainer with a placeholder
+        optimizer; init_optimizer replaces it."""
+        if self._trainer is None:
+            from ..parallel.trainer import Trainer
+            self._trainer = Trainer(
+                self._symbol, opt.SGD(), data_names=self._data_names,
+                label_names=self._label_names, mesh=self._mesh,
+                compute_dtype=self._compute_dtype)
+            self._trainer.bind(
+                data_shapes={d.name: d.shape for d in self._data_shapes},
+                label_shapes={d.name: d.shape
+                              for d in (self._label_shapes or [])})
+            self._trainer.init_params(arg_params=self._arg_params,
+                                      aux_params=self._aux_params,
+                                      force_init=True)
 
     def _fused_batch_dict(self, data_batch):
         batch = {}
@@ -414,7 +510,8 @@ class Module(BaseModule):
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        if self._trainer is not None:
+        if self._trainer is not None or (self._mesh is not None and
+                                         self._exec_group is None):
             assert out_grads is None, \
                 "fused mesh path computes gradients internally"
             return
@@ -444,9 +541,16 @@ class Module(BaseModule):
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        if self._trainer is not None:
+        if self._trainer is not None or (self._mesh is not None and
+                                         self._exec_group is None):
+            if self._fused_outputs is None and self._staged_batch is not None:
+                # outputs read between forward(is_train=True) and update():
+                # run a training-mode forward without the update
+                self._ensure_trainer()
+                self._fused_outputs = self._trainer.forward_train(
+                    self._staged_batch)
             assert self._fused_outputs is not None, \
-                "no outputs yet: run forward(is_train=False) or update()"
+                "no outputs yet: run forward() or update()"
             return self._fused_outputs
         return self._exec_group.get_outputs(merge_multi_context=merge_multi_context)
 
@@ -456,7 +560,12 @@ class Module(BaseModule):
             merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
-        if self._trainer is not None:
+        if self._trainer is not None or (self._mesh is not None and
+                                         self._exec_group is None):
+            if self._fused_outputs is None and self._staged_batch is not None:
+                # metric before update(): run a train-mode forward (the
+                # fit loop's update-then-metric order avoids this cost)
+                self.get_outputs()
             if self._fused_outputs is not None:
                 eval_metric.update(labels, self._fused_outputs)
             return
@@ -476,18 +585,21 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._trainer is not None:
+            with open(fname, "wb") as fout:
+                fout.write(self._trainer.get_opt_states())
+        elif self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
-        elif self._updater is not None:
+        else:
             with open(fname, "wb") as fout:
                 fout.write(self._updater.get_states())
-        else:
-            raise MXNetError("fused-path optimizer state save not yet "
-                             "supported; use the classic context path")
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
-        if self._update_on_kvstore:
+        if self._trainer is not None:
+            with open(fname, "rb") as fin:
+                self._trainer.set_opt_states(fin.read())
+        elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
             with open(fname, "rb") as fin:
@@ -497,3 +609,8 @@ class Module(BaseModule):
         assert self.binded
         if self._exec_group is not None:
             self._exec_group.install_monitor(mon)
+        else:
+            self.logger.warning(
+                "Monitor requires the classic executor path; the fused "
+                "mesh path has no per-op taps (the whole step is one XLA "
+                "computation). Set MXTPU_MODULE_FUSED=never to monitor.")

@@ -33,6 +33,11 @@ class SequentialModule(BaseModule):
         """Add a module to the chain (reference
         ``sequential_module.py:48``)."""
         self._modules.append(module)
+        # chained modules exchange activations/out_grads per step — that
+        # needs the classic executor path, not the fused one-program step
+        if hasattr(module, "_fused_mode"):
+            module._fused_mode = "never"
+
         for key in kwargs:
             assert key in self._meta_keys, ("Unknown meta \"%s\", a typo?" % key)
         self._metas.append(kwargs)

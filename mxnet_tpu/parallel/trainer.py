@@ -72,6 +72,7 @@ class Trainer:
         self._step_fn = None
         self._eval_fn = None
         self._batch_shardings = None
+        self._lr_cache = None
         self._key = jax.random.key(0)
 
     # ------------------------------------------------------------------
@@ -182,7 +183,12 @@ class Trainer:
             outs, _ = _forward(params, aux_vals, batch, key, False)
             return tuple(o.astype(jnp.float32) for o in outs)
 
-        if self.mesh is not None:
+        def evaluate_train(params, aux, batch, key):
+            aux_vals = [aux[n] for n in aux_names]
+            outs, _ = _forward(params, aux_vals, batch, key, True)
+            return tuple(o.astype(jnp.float32) for o in outs)
+
+        if self.mesh is not None and self.mesh.size > 1:
             mesh = self.mesh
             if "data" in mesh.axis_names:
                 self._batch_shardings = {
@@ -204,9 +210,13 @@ class Trainer:
             self._eval_fn = jax.jit(
                 evaluate,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
+            self._eval_train_fn = jax.jit(
+                evaluate_train,
+                in_shardings=(p_shard, a_shard, self._batch_shardings, None))
         else:
             self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
             self._eval_fn = jax.jit(evaluate)
+            self._eval_train_fn = jax.jit(evaluate_train)
 
     # ------------------------------------------------------------------
     def _device_batch(self, batch: Dict) -> Dict:
@@ -236,9 +246,12 @@ class Trainer:
         key = jax.random.fold_in(self._key, self.num_update) \
             if self.prog.has_rng else self._key
         dev_batch = self._device_batch(batch)
+        # cache the lr device scalar: one H2D per lr *change*, not per step
+        if self._lr_cache is None or self._lr_cache[0] != lr:
+            self._lr_cache = (lr, jnp.float32(lr))
         self.params, self.aux, self.opt_state, outs = self._step_fn(
             self.params, self.aux, self.opt_state, dev_batch,
-            jnp.float32(lr), jnp.int32(max(1, self.num_update)), key)
+            self._lr_cache[1], jnp.int32(max(1, self.num_update)), key)
         return [NDArray(o) for o in outs]
 
     def forward(self, batch: Dict) -> List[NDArray]:
@@ -246,6 +259,33 @@ class Trainer:
         dev_batch = self._device_batch(batch)
         outs = self._eval_fn(self.params, self.aux, dev_batch, self._key)
         return [NDArray(o) for o in outs]
+
+    def forward_train(self, batch: Dict) -> List[NDArray]:
+        """Training-mode forward WITHOUT the update — for callers that
+        read outputs between forward(is_train=True) and the fused step.
+        Costs one extra compiled program; the fused ``step`` is the fast
+        path."""
+        dev_batch = self._device_batch(batch)
+        outs = self._eval_train_fn(self.params, self.aux, dev_batch,
+                                   self._key)
+        return [NDArray(o) for o in outs]
+
+    def get_opt_states(self) -> bytes:
+        """Serialize (num_update, optimizer state pytree) — the fused
+        analog of ``Updater.get_states`` (reference ``optimizer.py``)."""
+        import pickle
+        state = jax.tree.map(np.asarray, self.opt_state)
+        return pickle.dumps((self.num_update, state))
+
+    def set_opt_states(self, blob: bytes) -> None:
+        import pickle
+        num_update, state = pickle.loads(blob)
+        self.num_update = num_update
+        self.optimizer.num_update = num_update
+        cur = self.opt_state
+        self.opt_state = jax.tree.map(
+            lambda c, n: jax.device_put(jnp.asarray(n), c.sharding)
+            if hasattr(c, "sharding") else jnp.asarray(n), cur, state)
 
     # ------------------------------------------------------------------
     def get_params(self):

@@ -1,0 +1,107 @@
+"""Metric tests (reference behavior: ``python/mxnet/metric.py``)."""
+import math
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _nd(a):
+    return mx.nd.array(np.asarray(a, dtype="float32"))
+
+
+def test_accuracy_argmax_and_direct():
+    m = mx.metric.create("acc")
+    m.update([_nd([0, 1, 1])], [_nd([[0.9, 0.1], [0.2, 0.8], [0.7, 0.3]])])
+    assert m.get() == ("accuracy", pytest.approx(2.0 / 3.0))
+    m.reset()
+    m.update([_nd([1, 0, 1])], [_nd([1, 0, 0])])  # same-shape: no argmax
+    assert m.get()[1] == pytest.approx(2.0 / 3.0)
+
+
+def test_accuracy_accumulates_across_updates():
+    m = mx.metric.Accuracy()
+    for _ in range(3):
+        m.update([_nd([0, 1])], [_nd([[0.9, 0.1], [0.1, 0.9]])])
+    name, val = m.get()
+    assert val == 1.0 and m.num_inst == 6 and m.sum_metric == 6.0
+
+
+def test_top_k_accuracy():
+    m = mx.metric.create("top_k_accuracy", top_k=2)
+    pred = [[0.1, 0.2, 0.7],   # top2 = {2, 1}
+            [0.8, 0.15, 0.05],  # top2 = {0, 1}
+            [0.3, 0.4, 0.3]]   # top2 = {1, 0}
+    m.update([_nd([1, 2, 2])], [_nd(pred)])
+    assert m.get() == ("top_k_accuracy_2", pytest.approx(1.0 / 3.0))
+    with pytest.raises(Exception):
+        mx.metric.TopKAccuracy(top_k=1)
+
+
+def test_f1_binary():
+    m = mx.metric.F1()
+    # preds: 1,1,0,0 ; labels: 1,0,1,0 -> tp=1 fp=1 fn=1 -> P=R=0.5, f1=0.5
+    m.update([_nd([1, 0, 1, 0])],
+             [_nd([[0.1, 0.9], [0.2, 0.8], [0.9, 0.1], [0.8, 0.2]])])
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_mae_mse_rmse():
+    label, pred = np.array([1.0, 2.0]), np.array([[1.5], [1.0]])
+    for name, want in [("mae", 0.75), ("mse", 0.625),
+                       ("rmse", math.sqrt(0.625))]:
+        m = mx.metric.create(name)
+        m.update([_nd(label)], [_nd(pred)])
+        assert m.get()[1] == pytest.approx(want), name
+        assert m.num_inst == 1
+
+
+def test_cross_entropy_and_perplexity():
+    label = np.array([0, 1])
+    pred = np.array([[0.8, 0.2], [0.3, 0.7]])
+    ce = mx.metric.create("ce")
+    ce.update([_nd(label)], [_nd(pred)])
+    want = -(math.log(0.8) + math.log(0.7)) / 2
+    assert ce.get()[1] == pytest.approx(want, rel=1e-5)
+
+    pp = mx.metric.Perplexity(ignore_label=None)
+    pp.update([_nd(label)], [_nd(pred)])
+    assert pp.get()[1] == pytest.approx(math.exp(want), rel=1e-5)
+
+    # ignored labels drop out of the count
+    pp2 = mx.metric.Perplexity(ignore_label=0)
+    pp2.update([_nd([0, 1])], [_nd(pred)])
+    assert pp2.get()[1] == pytest.approx(math.exp(-math.log(0.7)), rel=1e-5)
+
+
+def test_custom_metric_and_np_wrapper():
+    def feval(label, pred):
+        return float(np.abs(label - pred.ravel()).sum())
+
+    m = mx.metric.np(feval)
+    m.update([_nd([1.0, 2.0])], [_nd([1.5, 1.0])])
+    assert m.get()[1] == pytest.approx(1.5)
+    assert m.name == "feval"
+
+    m2 = mx.metric.CustomMetric(lambda l, p: (2.0, 4))
+    m2.update([_nd([0.0])], [_nd([0.0])])
+    assert m2.get()[1] == pytest.approx(0.5)
+
+
+def test_composite_metric():
+    m = mx.metric.create(["acc", "mse"])
+    m.update([_nd([0, 1])], [_nd([[0.9, 0.1], [0.1, 0.9]])])
+    names, vals = m.get()
+    assert names[0] == "accuracy" and vals[0] == 1.0
+
+
+def test_metric_no_update_is_nan():
+    m = mx.metric.Accuracy()
+    assert math.isnan(m.get()[1])
+
+
+def test_metric_mismatched_lists_raise():
+    m = mx.metric.Accuracy()
+    with pytest.raises(ValueError):
+        m.update([_nd([0]), _nd([1])], [_nd([[1, 0]])])

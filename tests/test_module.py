@@ -104,6 +104,40 @@ def test_module_mesh_fused():
     assert mod.score(train, "acc")[0][1] > 0.9
 
 
+def test_module_auto_fused(monkeypatch):
+    """MXTPU_MODULE_FUSED=always routes a plain-Context Module through
+    the fused Trainer (the default for tpu contexts)."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "always")
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=5, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    assert mod._trainer is not None and mod._exec_group is None
+    train.reset()
+    assert mod.score(train, "acc")[0][1] > 0.9
+    # outputs readable between forward(is_train=True) and update()
+    train.reset()
+    batch = next(iter(train))
+    mod.forward(batch, is_train=True)
+    assert mod.get_outputs()[0].shape == (20, 2)
+    mod.update()
+    # optimizer state roundtrip on the fused path
+    import tempfile, os as _os
+    fname = _os.path.join(tempfile.mkdtemp(), "opt.states")
+    mod.save_optimizer_states(fname)
+    mod.load_optimizer_states(fname)
+
+
+def test_module_auto_fused_predict(monkeypatch):
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "always")
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer_params={"learning_rate": 0.1})
+    train.reset()
+    out = mod.predict(train)
+    assert out.shape == (200, 2)
+
+
 def test_module_optimizer_state_roundtrip(tmp_path):
     train = _toy_data()
     mod = Module(_softmax_mlp(), context=mx.cpu())
