@@ -93,11 +93,13 @@ class EvalMetric(object):
                 s, n = self._jit_stat(label, pred)
             except ValueError:
                 # label and prediction live on different device sets
-                # (e.g. mesh-sharded outputs vs a host-fed label): gather
-                # to host once and keep doing so for this metric
-                self._gather = True
+                # (e.g. mesh-sharded outputs vs a host-fed label): retry
+                # gathered to host; only if that succeeds (a real
+                # sharding mismatch, not a user shape error) keep
+                # gathering for this metric
                 s, n = self._jit_stat(onp.asarray(label),
                                       onp.asarray(pred))
+                self._gather = True
             self._acc = _fold(self._acc[0], self._acc[1], s, n)
 
     def reset(self):

@@ -1,9 +1,12 @@
-"""BaseModule: the abstract training-loop surface.
+"""BaseModule: the abstract train/eval/predict surface.
 
-Reference: ``python/mxnet/module/base_module.py`` — ``fit`` (:368-490),
-``score``, ``predict``, ``iter_predict``, parameter get/set, and the
-bind/init_optimizer lifecycle contract that Module/BucketingModule/
-SequentialModule implement.
+API parity with the reference module layer (``python/mxnet/module/
+base_module.py``: ``fit``/``score``/``predict``/``iter_predict``, the
+bind → init_params → init_optimizer lifecycle, ``arg:``/``aux:`` param
+files), restructured around two shared drivers: ``_evaluation_pass``
+feeds every inference-style entry point, and ``fit`` delegates the inner
+loop to ``_train_epoch``.  Subclasses (Module, BucketingModule,
+SequentialModule) provide the computation primitives.
 """
 from __future__ import annotations
 
@@ -11,12 +14,8 @@ import logging
 import time
 from collections import namedtuple
 
-import numpy as np
-
-from .. import metric
+from .. import metric as metric_mod
 from .. import ndarray
-from ..base import MXNetError
-from ..io import DataDesc
 from ..ndarray import NDArray
 
 BatchEndParam = namedtuple("BatchEndParams",
@@ -24,34 +23,36 @@ BatchEndParam = namedtuple("BatchEndParams",
 
 
 def _as_list(obj):
-    if isinstance(obj, list):
-        return obj
-    return [obj]
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _invoke(callbacks, param):
+    for cb in _as_list(callbacks):
+        cb(param)
 
 
 def _check_input_names(symbol, names, typename, throw):
-    """Check that input names are in the symbol's arguments
-    (reference ``base_module.py:33``)."""
+    """Validate that every requested input exists among the symbol's
+    arguments; suggest likely input names (non-parameter args) if not."""
     args = symbol.list_arguments()
-    for name in names:
-        if name in args:
-            continue
-        candidates = [arg for arg in args if
-                      not arg.endswith("_weight") and
-                      not arg.endswith("_bias") and
-                      not arg.endswith("_gamma") and
-                      not arg.endswith("_beta")]
+    missing = [n for n in names if n not in args]
+    if not missing:
+        return
+    param_suffixes = ("_weight", "_bias", "_gamma", "_beta")
+    suggestions = [a for a in args if not a.endswith(param_suffixes)]
+    for name in missing:
         msg = "\033[91mYou created Module with Module(..., %s_names=%s) but " \
               "input with name '%s' is not found in symbol.list_arguments(). " \
               "Did you mean one of:\n\t%s\033[0m" % (
-                  typename, str(names), name, "\n\t".join(candidates))
+                  typename, str(names), name, "\n\t".join(suggestions))
         if throw:
             raise ValueError(msg)
         logging.warning(msg)
 
 
 class BaseModule(object):
-    """The base class of a module (reference ``base_module.py:55-120``)."""
+    """Abstract module: subclasses implement the computation primitives
+    (forward/backward/update/...) and inherit the high-level drivers."""
 
     def __init__(self, logger=logging):
         self.logger = logger
@@ -63,89 +64,81 @@ class BaseModule(object):
         self._symbol = None
         self._total_exec_bytes = 0
 
-    # ------------------------------------------------------------------
-    # high-level interface
+    # ==================================================================
+    # high-level drivers
     def forward_backward(self, data_batch):
-        """A convenient function that calls both ``forward`` and
-        ``backward`` (reference ``base_module.py:191``)."""
+        """Forward then backward in one call."""
         self.forward(data_batch, is_train=True)
         self.backward()
+
+    def _evaluation_pass(self, eval_data, num_batch, reset):
+        """Generator driving forward(is_train=False) over an iterator,
+        yielding ``(nbatch, batch, pad_stripped_outputs)``."""
+        assert self.binded and self.params_initialized
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
+                return
+            self.forward(batch, is_train=False)
+            keep = None if not batch.pad else -batch.pad
+            yield nbatch, batch, [NDArray(o.data[:keep])
+                                  for o in self.get_outputs()]
 
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
-        """Run prediction on ``eval_data`` and evaluate the performance
-        (reference ``base_module.py:199-250``)."""
+        """Evaluate ``eval_metric`` over an iterator."""
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        seen = 0
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        if not isinstance(eval_metric, metric.EvalMetric):
-            eval_metric = metric.create(eval_metric)
-        eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch >= num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
             if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
+                _invoke(batch_end_callback,
+                        BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric,
+                                      locals=locals()))
+            seen = nbatch + 1
         if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            _invoke(score_end_callback,
+                    BatchEndParam(epoch=epoch, nbatch=seen,
+                                  eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        """Iterate over predictions (reference ``base_module.py:252``)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+        """Yield ``(outputs, nbatch, batch)`` per evaluation batch."""
+        for nbatch, batch, outputs in self._evaluation_pass(
+                eval_data, num_batch, reset):
+            yield outputs, nbatch, batch
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
-        """Run prediction and collect the outputs
-        (reference ``base_module.py:279-330``)."""
-        assert self.binded and self.params_initialized
-        if reset:
-            eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [NDArray(out.data[0:out.shape[0] - pad])
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same " \
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [ndarray.concatenate([out[i] for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+        """Collect predictions; with ``merge_batches`` the per-batch
+        outputs are concatenated (and a single output unwrapped)."""
+        collected = [outputs for _, _, outputs in self._evaluation_pass(
+            eval_data, num_batch, reset)]
+        if not collected or not merge_batches:
+            return collected
+        width = len(collected[0])
+        if any(len(outs) != width for outs in collected):
+            raise AssertionError(
+                "Cannot merge batches: the number of outputs varies "
+                "across mini-batches. Maybe bucketing is used?")
+        merged = [ndarray.concatenate([outs[i] for outs in collected])
+                  for i in range(width)]
+        if width == 1 and not always_output_list:
+            return merged[0]
+        return merged
 
+    # ------------------------------------------------------------------
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
@@ -154,10 +147,11 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None):
-        """Train the module (reference ``base_module.py:368-490``)."""
+        """The training driver: bind, init, then epochs of
+        forward_backward/update/update_metric with callbacks."""
         assert num_epoch is not None, "please specify number of epochs"
-        from ..initializer import Uniform
         if initializer is None:
+            from ..initializer import Uniform
             initializer = Uniform(0.01)
 
         self.bind(data_shapes=train_data.provide_data,
@@ -170,56 +164,56 @@ class BaseModule(object):
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
-        if validation_metric is None:
-            validation_metric = eval_metric
-        if not isinstance(eval_metric, metric.EvalMetric):
-            eval_metric = metric.create(eval_metric)
 
-        # training loop
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-
-            # one epoch of training is finished
+            elapsed = self._train_epoch(epoch, train_data, eval_metric,
+                                        batch_end_callback, monitor)
             for name, val in eval_metric.get_name_value():
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, elapsed)
 
-            # sync aux params across devices
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-
+            # pull trained values off the devices and refresh host mirrors
+            arg_snap, aux_snap = self.get_params()
+            self.set_params(arg_snap, aux_snap)
             if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_snap, aux_snap)
 
-            # evaluation on validation set
             if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
+                for name, val in self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch):
+                    self.logger.info("Epoch[%d] Validation-%s=%f",
+                                     epoch, name, val)
             train_data.reset()
 
-    # ------------------------------------------------------------------
+    def _train_epoch(self, epoch, train_data, eval_metric,
+                     batch_end_callback, monitor):
+        """One pass over ``train_data``; returns the wall time."""
+        eval_metric.reset()
+        tic = time.time()
+        for nbatch, data_batch in enumerate(train_data):
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(data_batch)
+            self.update()
+            self.update_metric(eval_metric, data_batch.label)
+            if monitor is not None:
+                monitor.toc_print()
+            if batch_end_callback is not None:
+                _invoke(batch_end_callback,
+                        BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric,
+                                      locals=locals()))
+        return time.time() - tic
+
+    # ==================================================================
     # symbol / params
     @property
     def symbol(self):
@@ -239,30 +233,26 @@ class BaseModule(object):
                          force_init=force_init)
 
     def save_params(self, fname):
-        """Save params to file in the reference ``arg:``/``aux:`` naming
-        (reference ``base_module.py:344``)."""
+        """Write params with the reference's ``arg:``/``aux:`` key
+        prefixes (wire-compatible with ``ndarray.save``)."""
         arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
-        ndarray.save(fname, save_dict)
+        blob = {"arg:" + k: v for k, v in arg_params.items()}
+        blob.update(("aux:" + k, v) for k, v in aux_params.items())
+        ndarray.save(fname, blob)
 
     def load_params(self, fname):
-        """Load params from file (reference ``base_module.py:354``)."""
-        save_dict = ndarray.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
+        """Inverse of :meth:`save_params`."""
+        arg_params, aux_params = {}, {}
+        bins = {"arg": arg_params, "aux": aux_params}
+        for key, value in ndarray.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind not in bins or not name:
                 raise ValueError("Invalid param file " + fname)
+            bins[kind][name] = value
         self.set_params(arg_params, aux_params)
 
-    # ------------------------------------------------------------------
-    # computations
+    # ==================================================================
+    # computation primitives (subclass responsibility)
     def forward(self, data_batch, is_train=None):
         raise NotImplementedError()
 
@@ -281,8 +271,6 @@ class BaseModule(object):
     def update_metric(self, eval_metric, labels):
         raise NotImplementedError()
 
-    # ------------------------------------------------------------------
-    # module setup
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -293,8 +281,8 @@ class BaseModule(object):
                        force_init=False):
         raise NotImplementedError()
 
-    # ------------------------------------------------------------------
-    # misc
+    # ==================================================================
+    # introspection
     @property
     def data_names(self):
         raise NotImplementedError()

@@ -383,18 +383,27 @@ class Module(BaseModule):
         if self._mesh is not None and self._exec_group is None:
             from ..kvstore import KVStore as _KVStore
             from ..kvstore import create as _kv_create
+            from ..parallel.optim import _supports_fusion
             if isinstance(kvstore, _KVStore):
                 kv = kvstore
             elif isinstance(kvstore, str) and "dist" in kvstore:
                 kv = _kv_create(kvstore)
             else:
                 kv = None
-            if kv is not None and "dist" in kv.type and kv.num_workers > 1 \
-                    and self._auto_fused:
-                # Multi-host with only an auto-built single-host mesh: the
-                # fused step would not sync gradients across hosts.  Fall
-                # back to the classic path, whose KVStoreTPU psum does
-                # (pass an explicit global Mesh to fuse multi-host).
+            multihost_auto = (kv is not None and "dist" in kv.type and
+                              kv.num_workers > 1 and self._auto_fused)
+            if multihost_auto or not _supports_fusion(optimizer):
+                # Fall back to the classic executor path when the fused
+                # step cannot represent this configuration: (a) multi-host
+                # with only an auto-built single-host mesh (the fused step
+                # would not sync across hosts; KVStoreTPU's psum does —
+                # pass an explicit global Mesh to fuse multi-host), or
+                # (b) an optimizer without a pure fused-step rule (SGLD,
+                # user-defined subclasses).
+                if not multihost_auto:
+                    self.logger.warning(
+                        "optimizer %s has no fused-step rule; using the "
+                        "classic executor path", type(optimizer).__name__)
                 self._mesh = None
                 self._trainer = None
                 self._bind_exec_group()

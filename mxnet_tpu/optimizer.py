@@ -1,29 +1,39 @@
-"""Optimizers (reference ``python/mxnet/optimizer.py:10-755``).
+"""Optimizers.
 
-Same registry + class surface (SGD, DCASGD, NAG, SGLD, ccSGD, Adam, AdaGrad,
-RMSProp, AdaDelta, Ftrl, Test) and the ``Updater`` state holder used by
-KVStore.  Update math routes through the *fused update ops* registered in
-``op/optimizer_op.py`` (the analog of ``src/operator/optimizer_op.cc:18-98``)
-so a step is one XLA computation per weight; inside a fused Module train
-step the same expressions are inlined and fused with the gradient allreduce.
+Class surface matches the reference optimizer module (SGD, DCASGD, NAG,
+SGLD, ccSGD, Adam, AdaGrad, RMSProp, AdaDelta, Ftrl, Test + ``Updater``,
+registry, lr/wd multipliers — ``python/mxnet/optimizer.py``), but the
+execution model is TPU-native: every optimizer is defined by ONE pure
+function ``_rule(w, g, state, lr, wd, t) -> (w', state')`` in jnp.  The
+imperative ``update()`` path jits that rule per weight (the analog of the
+reference's fused ``optimizer_op.cc`` kernels), and the fused train step
+(:func:`mxnet_tpu.parallel.optim.make_update_fn`) inlines the *same rule*
+into the single step XLA program — one source of truth for the math.
 """
 from __future__ import annotations
 
 import logging
-import math
 import pickle
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from .base import MXNetError
-from .ndarray import NDArray, zeros
-from . import ndarray
+from .ndarray import NDArray
+
+
+def _leaf_data(x):
+    return x.data if isinstance(x, NDArray) else x
 
 
 class Optimizer(object):
-    """Base optimizer: lr/wd multipliers, update counting, registry."""
+    """Base: registry, update counting, lr/wd multiplier tables, and the
+    jit driver that runs a subclass's pure ``_rule``."""
 
     opt_registry = {}
+    has_noise = False           # rule takes a PRNG key (SGLD)
 
     def __init__(self, rescale_grad=1., param_idx2name=None, wd=0.,
                  clip_gradient=None, learning_rate=0.01,
@@ -34,92 +44,125 @@ class Optimizer(object):
         if lr_scheduler is not None:
             self.lr_scheduler.base_lr = learning_rate
         self.wd = wd
-        self.lr_mult = {}
-        self.wd_mult = {}
+        self.clip_gradient = clip_gradient
         self.begin_num_update = begin_num_update
         self.num_update = begin_num_update
         self._index_update_count = {}
-        self.clip_gradient = clip_gradient
         if param_idx2name is None:
             param_idx2name = {}
         if not isinstance(param_idx2name, dict):
-            raise MXNetError("param_idx2name should be a dict of param indexes to names.")
+            raise MXNetError(
+                "param_idx2name should be a dict of param indexes to names.")
         self.idx2name = param_idx2name.copy()
         self.sym = sym
+        self._compiled = None
+        self._noise_key = jax.random.key(12345)
         self.set_lr_mult({})
         self.set_wd_mult({})
 
+    # -- registry -------------------------------------------------------
     @staticmethod
     def register(klass):
-        name = klass.__name__.lower()
-        if name in Optimizer.opt_registry:
+        key = klass.__name__.lower()
+        if key in Optimizer.opt_registry:
             logging.warning("WARNING: New optimizer %s.%s is overriding "
                             "existing optimizer %s", klass.__module__,
-                            klass.__name__, name)
-        Optimizer.opt_registry[name] = klass
+                            klass.__name__, key)
+        Optimizer.opt_registry[key] = klass
         return klass
 
     @staticmethod
     def create_optimizer(name, **kwargs):
-        if name.lower() in Optimizer.opt_registry:
+        try:
             return Optimizer.opt_registry[name.lower()](**kwargs)
-        raise ValueError("Cannot find optimizer %s" % name)
+        except KeyError:
+            raise ValueError("Cannot find optimizer %s" % name)
 
-    def create_state(self, index, weight):
-        """Create per-weight state (momentum...)."""
-        return None
-
-    def update(self, index, weight, grad, state):
-        raise NotImplementedError()
+    # -- multiplier tables ----------------------------------------------
+    def _attr_table(self, attr_key):
+        """Collect ``__lr_mult__``-style per-arg attributes from the
+        bound symbol."""
+        table = {}
+        if self.sym is not None:
+            attrs = self.sym.attr_dict()
+            for arg in self.sym.list_arguments():
+                val = attrs.get(arg, {}).get(attr_key)
+                if val is not None:
+                    table[arg] = float(val)
+        return table
 
     def set_lr_scale(self, args_lrscale):
         raise DeprecationWarning("Use set_lr_mult instead.")
 
     def set_lr_mult(self, args_lr_mult):
-        self.lr_mult = {}
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and "__lr_mult__" in attr[name]:
-                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult = self._attr_table("__lr_mult__")
         self.lr_mult.update(args_lr_mult)
 
     def set_wd_mult(self, args_wd_mult):
-        self.wd_mult = {}
-        for n in self.idx2name.values():
-            if not (n.endswith("_weight") or n.endswith("_gamma")):
-                self.wd_mult[n] = 0.0
-        if self.sym is not None:
-            attr = self.sym.attr_dict()
-            for name in self.sym.list_arguments():
-                if name in attr and "__wd_mult__" in attr[name]:
-                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        # biases / norm scales decay at 0 unless told otherwise
+        self.wd_mult = {
+            n: 0.0 for n in self.idx2name.values()
+            if not n.endswith(("_weight", "_gamma"))}
+        self.wd_mult.update(self._attr_table("__wd_mult__"))
         self.wd_mult.update(args_wd_mult)
 
-    def _update_count(self, index):
-        if index not in self._index_update_count:
-            self._index_update_count[index] = self.begin_num_update
-        self._index_update_count[index] += 1
-        self.num_update = max(self._index_update_count[index], self.num_update)
+    def _mult_for(self, table, index, default=1.0):
+        if index in table:
+            return table[index]
+        return table.get(self.idx2name.get(index), default)
 
     def _get_lr(self, index):
-        if self.lr_scheduler is not None:
-            lr = self.lr_scheduler(self.num_update)
-        else:
-            lr = self.lr
-        if index in self.lr_mult:
-            lr *= self.lr_mult[index]
-        elif index in self.idx2name:
-            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
-        return lr
+        base = (self.lr_scheduler(self.num_update)
+                if self.lr_scheduler is not None else self.lr)
+        return base * self._mult_for(self.lr_mult, index)
 
     def _get_wd(self, index):
-        wd = self.wd
-        if index in self.wd_mult:
-            wd *= self.wd_mult[index]
-        elif index in self.idx2name:
-            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
-        return wd
+        return self.wd * self._mult_for(self.wd_mult, index)
+
+    def _update_count(self, index):
+        count = self._index_update_count.get(index, self.begin_num_update) + 1
+        self._index_update_count[index] = count
+        self.num_update = max(count, self.num_update)
+
+    # -- the pure rule + its driver -------------------------------------
+    def _state(self, w):
+        """Pure state init from a jnp weight (None = stateless)."""
+        return None
+
+    def _rule(self, w, g, state, lr, wd, t):
+        raise NotImplementedError()
+
+    def _prep_grad(self, g, w, wd):
+        """Shared preprocessing: rescale, clip, weight decay."""
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None and self.clip_gradient > 0:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g + wd * w
+
+    def create_state(self, index, weight):
+        """Per-weight state as (possibly nested) NDArrays."""
+        return jax.tree.map(NDArray, self._state(weight.data))
+
+    def update(self, index, weight, grad, state):
+        """Imperative update: one jitted XLA program per weight."""
+        # reference ordering: lr reads the pre-increment num_update, the
+        # bias-correction step t the post-increment per-index count
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        self._update_count(index)
+        t = self._index_update_count[index]
+        if self._compiled is None:
+            self._compiled = jax.jit(self._rule)
+        args = [weight.data, grad.data, jax.tree.map(_leaf_data, state),
+                np.float32(lr), np.float32(wd), np.int32(t)]
+        if self.has_noise:
+            self._noise_key, sub = jax.random.split(self._noise_key)
+            args.append(sub)
+        new_w, new_state = self._compiled(*args)
+        weight._set_data(new_w)
+        for holder, value in zip(jax.tree.leaves(state),
+                                 jax.tree.leaves(new_state)):
+            holder._set_data(value)
 
 
 register = Optimizer.register
@@ -127,286 +170,223 @@ register = Optimizer.register
 
 @register
 class SGD(Optimizer):
-    """SGD with momentum; fused via ``sgd_update``/``sgd_mom_update``
-    (reference ``optimizer.py:278-323``)."""
+    """(Momentum) SGD.  Reference semantics of ``sgd_update`` /
+    ``sgd_mom_update`` (``src/operator/optimizer_op.cc:18-60``)."""
 
     def __init__(self, momentum=0.0, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
 
-    def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return None
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+    def _state(self, w):
+        return jnp.zeros_like(w) if self.momentum else None
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
-                      clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
-        if state is not None:
-            ndarray.sgd_mom_update(weight, grad, state, out=[weight, state],
-                                   momentum=self.momentum, **kwargs)
-        else:
-            ndarray.sgd_update(weight, grad, out=weight, **kwargs)
+    def _rule(self, w, g, mom, lr, wd, t):
+        g = self._prep_grad(g, w, wd)
+        if mom is None:
+            return w - lr * g, None
+        mom = self.momentum * mom - lr * g
+        return w + mom, mom
 
 
 @register
-class DCASGD(Optimizer):
-    """Delay-compensated async SGD (reference ``optimizer.py:325-377``)."""
-
-    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
-        super().__init__(**kwargs)
-        self.momentum = momentum
-        self.weight_previous = {}
-        self.lamda = lamda
-
-    def create_state(self, index, weight):
-        if self.momentum == 0.0:
-            return (None, weight.copy())
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                weight.copy())
-
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
-                                a_max=self.clip_gradient)
-        mom, previous_weight = state
-        dc = grad + wd * weight + self.lamda * grad * grad * (weight - previous_weight)
-        if mom is not None:
-            mom *= self.momentum
-            mom -= lr * dc
-            delta = mom
-        else:
-            delta = -lr * dc
-        previous_weight[:] = weight
-        weight += delta
+class ccSGD(SGD):  # noqa: N801 — reference spelling
+    """Deprecated alias of SGD."""
 
 
 @register
 class NAG(SGD):
-    """Nesterov accelerated SGD (reference ``optimizer.py:380-413``)."""
+    """Nesterov-accelerated SGD."""
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
-                                a_max=self.clip_gradient)
-        if state is not None:
-            mom = state
-            mom *= self.momentum
-            grad += wd * weight
-            mom += grad
-            grad += self.momentum * mom
-            weight -= lr * grad
-        else:
-            weight -= lr * (grad + wd * weight)
+    def _rule(self, w, g, mom, lr, wd, t):
+        g = self._prep_grad(g, w, wd)
+        if mom is None:
+            return w - lr * g, None
+        mom = self.momentum * mom + g
+        return w - lr * (g + self.momentum * mom), mom
 
 
 @register
 class SGLD(Optimizer):
-    """Stochastic gradient Langevin dynamics (reference ``optimizer.py:416``)."""
+    """Stochastic gradient Langevin dynamics: SGD plus sqrt(lr) Gaussian
+    noise — the rule draws from a per-optimizer PRNG key chain."""
 
-    def create_state(self, index, weight):
-        return None
+    has_noise = True
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
-                                a_max=self.clip_gradient)
-        noise = ndarray.normal(loc=0.0, scale=math.sqrt(lr),
-                               shape=weight.shape, dtype=weight.dtype)
-        weight -= lr / 2 * (grad + wd * weight)
-        weight += noise
+    def _rule(self, w, g, state, lr, wd, t, key):
+        g = self._prep_grad(g, w, wd)
+        noise = jnp.sqrt(lr) * jax.random.normal(key, w.shape, w.dtype)
+        return w - 0.5 * lr * g + noise, state
 
 
-@register  # noqa: N801 - reference spells it ccSGD
-class ccSGD(SGD):
-    """[Deprecated alias] same as SGD (reference ``optimizer.py:444``)."""
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD: corrects the gradient with a
+    curvature term against the weight snapshot from push time."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def _state(self, w):
+        mom = jnp.zeros_like(w) if self.momentum else None
+        return (mom, w)
+
+    def _rule(self, w, g, state, lr, wd, t):
+        mom, snapshot = state
+        g = self._prep_grad(g, w, 0.0)
+        comp = g + wd * w + self.lamda * g * g * (w - snapshot)
+        if mom is None:
+            step = -lr * comp
+        else:
+            mom = self.momentum * mom - lr * comp
+            step = mom
+        return w + step, (mom, w)
 
 
 @register
 class Adam(Optimizer):
-    """Adam, fused via ``adam_update`` (reference ``optimizer.py:451-496``)."""
+    """Adam with bias correction folded into the step size."""
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.beta1 = beta1
-        self.beta2 = beta2
-        self.epsilon = epsilon
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
 
-    def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+    def _state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        t = self._index_update_count[index]
+    def _rule(self, w, g, state, lr, wd, t):
         mean, var = state
-        ndarray.adam_update(weight, grad, mean, var,
-                            out=[weight, mean, var],
-                            lr=lr, wd=wd, beta1=self.beta1, beta2=self.beta2,
-                            epsilon=self.epsilon, t=t,
-                            rescale_grad=self.rescale_grad,
-                            clip_gradient=self.clip_gradient if self.clip_gradient else -1.0)
+        g = self._prep_grad(g, w, wd)
+        mean = self.beta1 * mean + (1 - self.beta1) * g
+        var = self.beta2 * var + (1 - self.beta2) * jnp.square(g)
+        step = lr * jnp.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        return w - step * mean / (jnp.sqrt(var) + self.epsilon), (mean, var)
 
 
 @register
 class AdaGrad(Optimizer):
-    """AdaGrad (reference ``optimizer.py:499-533``)."""
+    """AdaGrad; wd applied outside the adaptive scaling (reference
+    behavior)."""
 
     def __init__(self, eps=1e-7, **kwargs):
         super().__init__(**kwargs)
         self.float_stable_eps = eps
 
-    def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context, dtype=weight.dtype)
+    def _state(self, w):
+        return jnp.zeros_like(w)
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
-                                a_max=self.clip_gradient)
-        history = state
-        history += grad * grad
-        weight -= lr * (grad / ndarray.sqrt(history + self.float_stable_eps)
-                        + wd * weight)
+    def _rule(self, w, g, hist, lr, wd, t):
+        g = self._prep_grad(g, w, 0.0)
+        hist = hist + jnp.square(g)
+        scaled = g * jax.lax.rsqrt(hist + self.float_stable_eps)
+        return w - lr * (scaled + wd * w), hist
 
 
 @register
 class RMSProp(Optimizer):
-    """RMSProp (Tieleman/Graves variants), fused via ``rmsprop_update``/
-    ``rmspropalex_update`` (reference ``optimizer.py:536-602``)."""
+    """RMSProp — Tieleman (plain) or Graves (centered) variant."""
 
     def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
                  epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
-        self.gamma1 = gamma1
-        self.gamma2 = gamma2
-        self.centered = centered
+        self.gamma1, self.gamma2 = gamma1, gamma2
         self.epsilon = epsilon
+        self.centered = centered
         self.clip_weights = clip_weights
 
-    def create_state(self, index, weight):
+    def _state(self, w):
         if self.centered:
-            return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # n
-                    zeros(weight.shape, weight.context, dtype=weight.dtype),  # g
-                    zeros(weight.shape, weight.context, dtype=weight.dtype))  # delta
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),)  # n
+            return (jnp.zeros_like(w),) * 3      # n, g-bar, delta
+        return (jnp.zeros_like(w),)
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
-                      gamma1=self.gamma1, epsilon=self.epsilon,
-                      clip_gradient=self.clip_gradient if self.clip_gradient else -1.0,
-                      clip_weights=self.clip_weights if self.clip_weights else -1.0)
+    def _rule(self, w, g, state, lr, wd, t):
+        g = self._prep_grad(g, w, wd)
         if not self.centered:
-            n, = state
-            ndarray.rmsprop_update(weight, grad, n, out=[weight, n], **kwargs)
+            (n,) = state
+            n = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            w = w - lr * g / jnp.sqrt(n + self.epsilon)
+            state = (n,)
         else:
-            n, g, delta = state
-            ndarray.rmspropalex_update(weight, grad, n, g, delta,
-                                       out=[weight, n, g, delta],
-                                       gamma2=self.gamma2, **kwargs)
+            n, gbar, delta = state
+            n = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n
+            gbar = (1 - self.gamma1) * g + self.gamma1 * gbar
+            delta = self.gamma2 * delta - \
+                lr * g * jax.lax.rsqrt(n - jnp.square(gbar) + self.epsilon)
+            w = w + delta
+            state = (n, gbar, delta)
+        if self.clip_weights is not None and self.clip_weights > 0:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        return w, state
 
 
 @register
 class AdaDelta(Optimizer):
-    """AdaDelta (reference ``optimizer.py:605-650``)."""
+    """AdaDelta: unit-corrected steps from running grad/delta averages."""
 
     def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
         super().__init__(**kwargs)
-        self.rho = rho
-        self.epsilon = epsilon
+        self.rho, self.epsilon = rho, epsilon
 
-    def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),
-                zeros(weight.shape, weight.context, dtype=weight.dtype))
+    def _state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
-                                a_max=self.clip_gradient)
-        acc_g, acc_delta = state
-        acc_g[:] = self.rho * acc_g + (1. - self.rho) * grad * grad
-        current_delta = (ndarray.sqrt(acc_delta + self.epsilon)
-                         / ndarray.sqrt(acc_g + self.epsilon)) * grad
-        acc_delta[:] = self.rho * acc_delta + (1. - self.rho) * current_delta * current_delta
-        weight[:] = weight - current_delta - wd * weight
+    def _rule(self, w, g, state, lr, wd, t):
+        acc_g, acc_d = state
+        g = self._prep_grad(g, w, 0.0)
+        acc_g = self.rho * acc_g + (1 - self.rho) * jnp.square(g)
+        step = jnp.sqrt(acc_d + self.epsilon) * \
+            jax.lax.rsqrt(acc_g + self.epsilon) * g
+        acc_d = self.rho * acc_d + (1 - self.rho) * jnp.square(step)
+        return w - step - wd * w, (acc_g, acc_d)
 
 
 @register
 class Ftrl(Optimizer):
-    """FTRL-proximal (reference ``optimizer.py:653-703``)."""
+    """FTRL-proximal with L1 shrinkage ``lamda1``."""
 
     def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.lamda1 = lamda1
         self.beta = beta
 
-    def create_state(self, index, weight):
-        return (zeros(weight.shape, weight.context, dtype=weight.dtype),  # dn
-                zeros(weight.shape, weight.context, dtype=weight.dtype))  # n
+    def _state(self, w):
+        return (jnp.zeros_like(w), jnp.zeros_like(w))
 
-    def update(self, index, weight, grad, state):
-        lr = self._get_lr(index)
-        wd = self._get_wd(index)
-        self._update_count(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = ndarray.clip(grad, a_min=-self.clip_gradient,
-                                a_max=self.clip_gradient)
-        dn, n = state
-        dn += grad - (ndarray.sqrt(n + grad * grad) - ndarray.sqrt(n)) * weight / lr
-        n += grad * grad
-        w = (ndarray.sign(dn) * self.lamda1 - dn) / \
-            ((self.beta + ndarray.sqrt(n)) / lr + wd) * \
-            (ndarray.abs(dn) > self.lamda1)
-        weight[:] = w
+    def _rule(self, w, g, state, lr, wd, t):
+        z, n = state
+        g = self._prep_grad(g, w, 0.0)
+        z = z + g - (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) * w / lr
+        n = n + jnp.square(g)
+        active = jnp.abs(z) > self.lamda1
+        w = jnp.where(
+            active,
+            (jnp.sign(z) * self.lamda1 - z) /
+            ((self.beta + jnp.sqrt(n)) / lr + wd),
+            0.0).astype(w.dtype)
+        return w, (z, n)
 
 
 @register
 class Test(Optimizer):
-    """Do-nothing-but-add optimizer for kvstore tests
-    (reference ``optimizer.py:706-717``)."""
+    """Deterministic test rule for kvstore tests: w += rescale*g, state
+    mirrors the weight."""
 
-    def create_state(self, index, weight):
-        return zeros(weight.shape, weight.context)
+    def _state(self, w):
+        return jnp.zeros_like(w)
 
-    def update(self, index, weight, grad, state):
-        weight[:] = weight + grad * self.rescale_grad
-        state[:] = weight
+    def _rule(self, w, g, state, lr, wd, t):
+        w = w + self.rescale_grad * g
+        return w, w
 
 
 create = Optimizer.create_optimizer
 
 
 class Updater(object):
-    """Per-index state holder applying an Optimizer
-    (reference ``optimizer.py:722-744``)."""
+    """Per-index state holder bridging KVStore's ``(key, grad, weight)``
+    callback to an Optimizer."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer

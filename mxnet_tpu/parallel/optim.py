@@ -1,162 +1,56 @@
-"""Optimizer update rules as pure pytree functions.
+"""Optimizer updates for the fused train step.
 
-The imperative :mod:`mxnet_tpu.optimizer` classes apply one fused op per
-weight from Python.  Inside the fused train step the same math must be a
-*pure function* of (params, grads, state) so the whole update compiles into
-the single step XLA program (reference analog: the kvstore updater fusing
-into ``optimizer_op.cc`` kernels — here fusing further, into the step).
+Every :class:`mxnet_tpu.optimizer.Optimizer` subclass defines its math as
+one pure ``_rule(w, g, state, lr, wd, t)`` function (see optimizer.py).
+The imperative path jits that rule per weight; here the *same rule* is
+inlined across the whole parameter pytree so the update fuses into the
+single step XLA program together with the gradient all-reduce (the
+reference analog: kvstore updater + ``optimizer_op.cc`` kernels, fused
+one level further).
 
-``make_update_fn(optimizer, param_names)`` converts a configured
-:class:`~mxnet_tpu.optimizer.Optimizer` instance into ``(init_fn,
-update_fn)`` honoring rescale_grad / clip_gradient / wd with per-name
-wd_mult (biases and norm scales get wd=0, matching
-``Optimizer.set_wd_mult``).
+``make_update_fn(optimizer, param_names)`` returns ``(init_fn,
+update_fn)`` honoring per-name lr/wd multipliers (biases and norm scales
+default to wd 0, matching ``Optimizer.set_wd_mult``).
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, List, Tuple
-
-import jax
-import jax.numpy as jnp
+from typing import Callable, List, Tuple
 
 from ..base import MXNetError
 from .. import optimizer as _opt
 
 
-def _prep(grad, weight, rescale, clip, wd):
-    g = grad * rescale
-    if clip is not None and clip > 0:
-        g = jnp.clip(g, -clip, clip)
-    return g + wd * weight
+def _supports_fusion(optimizer):
+    return (not optimizer.has_noise and
+            type(optimizer)._rule is not _opt.Optimizer._rule)
 
 
 def make_update_fn(optimizer: "_opt.Optimizer", param_names: List[str]
                    ) -> Tuple[Callable, Callable]:
-    """Build ``init_fn(params) -> state`` and
-    ``update_fn(params, grads, state, lr, t) -> (params, state)``.
+    """``init_fn(params) -> state`` and ``update_fn(params, grads, state,
+    lr, t) -> (params, state)``.  ``lr``/``t`` enter as traced scalars so
+    LR schedules never trigger recompilation."""
+    if not _supports_fusion(optimizer):
+        raise MXNetError(
+            "optimizer %s has no pure fused-step rule; Module falls back "
+            "to the per-weight imperative update path"
+            % type(optimizer).__name__)
 
-    ``lr`` and ``t`` enter as traced scalars so LR schedules never trigger
-    recompilation.
-    """
-    rescale = optimizer.rescale_grad
-    clip = optimizer.clip_gradient
-    wd_mult = {n: optimizer.wd_mult.get(
-        n, 0.0 if not (n.endswith("_weight") or n.endswith("_gamma"))
-        else 1.0) for n in param_names}
-    lr_mult = {n: optimizer.lr_mult.get(n, 1.0) for n in param_names}
-    base_wd = optimizer.wd
+    def scales(name):
+        lr_mult = optimizer.lr_mult.get(name, 1.0)
+        wd_default = 1.0 if name.endswith(("_weight", "_gamma")) else 0.0
+        wd = optimizer.wd * optimizer.wd_mult.get(name, wd_default)
+        return lr_mult, wd
 
-    def per_param(fn):
-        def init_fn(params):
-            return {n: fn.init(params[n]) for n in param_names}
+    def init_fn(params):
+        return {n: optimizer._state(params[n]) for n in param_names}
 
-        def update_fn(params, grads, state, lr, t):
-            new_p, new_s = {}, {}
-            for n in param_names:
-                wd = base_wd * wd_mult[n]
-                p, s = fn.update(params[n], grads[n], state[n],
-                                 lr * lr_mult[n], t, wd)
-                new_p[n], new_s[n] = p, s
-            return new_p, new_s
+    def update_fn(params, grads, state, lr, t):
+        new_params, new_state = {}, {}
+        for n in param_names:
+            lr_mult, wd = scales(n)
+            new_params[n], new_state[n] = optimizer._rule(
+                params[n], grads[n], state[n], lr * lr_mult, wd, t)
+        return new_params, new_state
 
-        return init_fn, update_fn
-
-    class _Rule:
-        pass
-
-    if isinstance(optimizer, _opt.NAG):
-        momentum = optimizer.momentum
-        rule = _Rule()
-        rule.init = lambda w: jnp.zeros_like(w)
-        def _nag(w, g, mom, lr, t, wd):
-            g = _prep(g, w, rescale, clip, 0.0) + wd * w
-            mom = momentum * mom + g
-            return w - lr * (g + momentum * mom), mom
-        rule.update = _nag
-        return per_param(rule)
-
-    if isinstance(optimizer, _opt.SGD):  # covers ccSGD too
-        momentum = optimizer.momentum
-        rule = _Rule()
-        if momentum == 0.0:
-            rule.init = lambda w: jnp.zeros((), w.dtype)
-            rule.update = lambda w, g, s, lr, t, wd: (
-                w - lr * _prep(g, w, rescale, clip, wd), s)
-        else:
-            rule.init = lambda w: jnp.zeros_like(w)
-            def _sgd_mom(w, g, mom, lr, t, wd):
-                mom = momentum * mom - lr * _prep(g, w, rescale, clip, wd)
-                return w + mom, mom
-            rule.update = _sgd_mom
-        return per_param(rule)
-
-    if isinstance(optimizer, _opt.Adam):
-        b1, b2, eps = optimizer.beta1, optimizer.beta2, optimizer.epsilon
-        rule = _Rule()
-        rule.init = lambda w: (jnp.zeros_like(w), jnp.zeros_like(w))
-        def _adam(w, g, s, lr, t, wd):
-            mean, var = s
-            g = _prep(g, w, rescale, clip, wd)
-            mean = b1 * mean + (1 - b1) * g
-            var = b2 * var + (1 - b2) * g * g
-            coef = lr * jnp.sqrt(1 - b2 ** t) / (1 - b1 ** t)
-            return w - coef * mean / (jnp.sqrt(var) + eps), (mean, var)
-        rule.update = _adam
-        return per_param(rule)
-
-    if isinstance(optimizer, _opt.RMSProp):
-        g1, g2, eps = optimizer.gamma1, optimizer.gamma2, optimizer.epsilon
-        centered = optimizer.centered
-        rule = _Rule()
-        if not centered:
-            rule.init = lambda w: jnp.zeros_like(w)
-            def _rms(w, g, n, lr, t, wd):
-                g = _prep(g, w, rescale, clip, wd)
-                n = (1 - g1) * g * g + g1 * n
-                return w - lr * g / jnp.sqrt(n + eps), n
-            rule.update = _rms
-        else:
-            rule.init = lambda w: (jnp.zeros_like(w), jnp.zeros_like(w),
-                                   jnp.zeros_like(w))
-            def _rmsalex(w, g, s, lr, t, wd):
-                n, gs, delta = s
-                g = _prep(g, w, rescale, clip, wd)
-                n = (1 - g1) * g * g + g1 * n
-                gs = (1 - g1) * g + g1 * gs
-                delta = g2 * delta - lr * g / jnp.sqrt(n - gs * gs + eps)
-                return w + delta, (n, gs, delta)
-            rule.update = _rmsalex
-        return per_param(rule)
-
-    if isinstance(optimizer, _opt.AdaGrad):
-        eps = optimizer.float_stable_eps
-        rule = _Rule()
-        rule.init = lambda w: jnp.zeros_like(w)
-        def _adagrad(w, g, h, lr, t, wd):
-            g = g * rescale
-            if clip is not None and clip > 0:
-                g = jnp.clip(g, -clip, clip)
-            h = h + g * g
-            return w - lr * (g / jnp.sqrt(h + eps) + wd * w), h
-        rule.update = _adagrad
-        return per_param(rule)
-
-    if isinstance(optimizer, _opt.AdaDelta):
-        rho, eps = optimizer.rho, optimizer.epsilon
-        rule = _Rule()
-        rule.init = lambda w: (jnp.zeros_like(w), jnp.zeros_like(w))
-        def _adadelta(w, g, s, lr, t, wd):
-            acc_g, acc_d = s
-            g = g * rescale
-            if clip is not None and clip > 0:
-                g = jnp.clip(g, -clip, clip)
-            acc_g = rho * acc_g + (1 - rho) * g * g
-            cur = jnp.sqrt(acc_d + eps) / jnp.sqrt(acc_g + eps) * g
-            acc_d = rho * acc_d + (1 - rho) * cur * cur
-            return w - cur - wd * w, (acc_g, acc_d)
-        rule.update = _adadelta
-        return per_param(rule)
-
-    raise MXNetError(
-        "optimizer %s has no fused-step rule; Module falls back to the "
-        "per-weight imperative update path" % type(optimizer).__name__)
+    return init_fn, update_fn

@@ -1,64 +1,79 @@
-"""Bucketed sequence IO (reference ``python/mxnet/rnn/io.py``)."""
-from __future__ import annotations
+"""Sequence bucketing IO for RNN training.
 
-import bisect
-import random
+API parity with the reference rnn io module (``encode_sentences`` +
+``BucketSentenceIter``, ``python/mxnet/rnn/io.py``), re-implemented
+vectorized: sentences are assigned to buckets with one ``searchsorted``
+pass and padded into a single ``[rows, bucket_len]`` matrix per bucket;
+next-token labels are the data matrix shifted one step left.  Batches
+carry ``bucket_key`` so BucketingModule's per-bucket jit cache compiles
+one XLA program per sequence length.
+"""
+from __future__ import annotations
 
 import numpy as np
 
 from .. import ndarray
-from ..io import DataIter, DataBatch, DataDesc
+from ..io import DataBatch, DataDesc, DataIter
 
 
 def encode_sentences(sentences, vocab=None, invalid_label=-1,
                      invalid_key="\n", start_label=0):
-    """Encode sentences to int arrays, building a vocab on the fly
-    (reference ``io.py:13-60``)."""
-    idx = start_label
-    if vocab is None:
+    """Map token sequences to int-id sequences.  With ``vocab=None`` a
+    fresh vocabulary is grown (``invalid_key`` pinned to
+    ``invalid_label``); otherwise unknown tokens are an error.  Returns
+    ``(encoded, vocab)``."""
+    grow = vocab is None
+    if grow:
         vocab = {invalid_key: invalid_label}
-        new_vocab = True
-    else:
-        new_vocab = False
-    res = []
+    next_id = start_label
+    encoded = []
     for sent in sentences:
-        coded = []
-        for word in sent:
-            if word not in vocab:
-                assert new_vocab, "Unknown token %s" % word
-                if idx == invalid_label:
-                    idx += 1
-                vocab[word] = idx
-                idx += 1
-            coded.append(vocab[word])
-        res.append(coded)
-    return res, vocab
+        row = []
+        for tok in sent:
+            code = vocab.get(tok)
+            if code is None:
+                assert grow, "Unknown token %s" % tok
+                if next_id == invalid_label:
+                    next_id += 1
+                code = vocab[tok] = next_id
+                next_id += 1
+            row.append(code)
+        encoded.append(row)
+    return encoded, vocab
 
 
 class BucketSentenceIter(DataIter):
-    """Bucketing iterator for variable-length sequences
-    (reference ``io.py:61-168``).  Batches carry ``bucket_key`` so a
-    BucketingModule compiles one program per bucket (jit shape cache)."""
+    """Bucketed iterator over variable-length id sequences.
+
+    Each batch is drawn from one bucket (all rows padded to that
+    bucket's length with ``invalid_label``) and tagged with
+    ``bucket_key`` for the BucketingModule jit cache.  ``layout`` "NTC"
+    yields batch-major arrays, "TNC" time-major.
+    """
 
     def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
-                 data_name="data", label_name="softmax_label", dtype="float32",
-                 layout="NTC"):
+                 data_name="data", label_name="softmax_label",
+                 dtype="float32", layout="NTC"):
         super().__init__()
+        lengths = np.fromiter((len(s) for s in sentences), dtype=np.int64,
+                              count=len(sentences))
         if not buckets:
-            buckets = [i for i, j in enumerate(np.bincount(
-                [len(s) for s in sentences])) if j >= batch_size]
-        buckets.sort()
-        ndiscard = 0
-        self.data = [[] for _ in buckets]
-        for sent in sentences:
-            buck = bisect.bisect_left(buckets, len(sent))
-            if buck == len(buckets):
-                ndiscard += 1
-                continue
-            buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
-            buff[:len(sent)] = sent
-            self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
+            hist = np.bincount(lengths)
+            buckets = np.nonzero(hist >= batch_size)[0].tolist()
+        buckets = sorted(buckets)
+        assert buckets, "no buckets (every length rarer than batch_size?)"
+
+        # one searchsorted pass: smallest bucket that fits each sentence
+        edges = np.asarray(buckets)
+        assignment = np.searchsorted(edges, lengths, side="left")
+
+        self._store = []
+        for b, blen in enumerate(buckets):
+            rows = np.nonzero(assignment == b)[0]
+            mat = np.full((rows.size, blen), invalid_label, dtype=dtype)
+            for r, si in enumerate(rows):
+                mat[r, :lengths[si]] = sentences[si]
+            self._store.append(mat)
 
         self.batch_size = batch_size
         self.buckets = buckets
@@ -66,65 +81,55 @@ class BucketSentenceIter(DataIter):
         self.label_name = label_name
         self.dtype = dtype
         self.invalid_label = invalid_label
-        self.nddata = []
-        self.ndlabel = []
+        self.layout = layout
         self.major_axis = layout.find("N")
+        if self.major_axis not in (0, 1):
+            raise ValueError("layout %s must be batch-major (N first) or "
+                             "time-major (N second)" % layout)
         self.default_bucket_key = max(buckets)
 
-        if self.major_axis == 0:
-            self.provide_data = [DataDesc(data_name,
-                                          (batch_size, self.default_bucket_key),
-                                          layout=layout)]
-            self.provide_label = [DataDesc(label_name,
-                                           (batch_size, self.default_bucket_key),
-                                           layout=layout)]
-        elif self.major_axis == 1:
-            self.provide_data = [DataDesc(data_name,
-                                          (self.default_bucket_key, batch_size),
-                                          layout=layout)]
-            self.provide_label = [DataDesc(label_name,
-                                           (self.default_bucket_key, batch_size),
-                                           layout=layout)]
-        else:
-            raise ValueError("Invalid layout %s: Must by NT (batch major) or "
-                             "TN (time major)" % layout)
+        shape = ((batch_size, self.default_bucket_key)
+                 if self.major_axis == 0
+                 else (self.default_bucket_key, batch_size))
+        self.provide_data = [DataDesc(data_name, shape, layout=layout)]
+        self.provide_label = [DataDesc(label_name, shape, layout=layout)]
 
-        self.idx = []
-        for i, buck in enumerate(self.data):
-            self.idx.extend([(i, j) for j in
-                             range(0, len(buck) - batch_size + 1, batch_size)])
-        self.curr_idx = 0
+        # (bucket, row-offset) table of full batches
+        self._batches = [(b, ofs)
+                         for b, mat in enumerate(self._store)
+                         for ofs in range(0, mat.shape[0] - batch_size + 1,
+                                          batch_size)]
+        self._order = np.arange(len(self._batches))
+        self._cursor = 0
         self.reset()
 
     def reset(self):
-        self.curr_idx = 0
-        random.shuffle(self.idx)
-        for buck in self.data:
-            np.random.shuffle(buck)
-        self.nddata = []
-        self.ndlabel = []
-        for buck in self.data:
-            label = np.empty_like(buck)
-            label[:, :-1] = buck[:, 1:]
-            label[:, -1] = self.invalid_label
-            self.nddata.append(ndarray.array(buck, dtype=self.dtype))
-            self.ndlabel.append(ndarray.array(label, dtype=self.dtype))
+        self._cursor = 0
+        np.random.shuffle(self._order)
+        self._device = []
+        for mat in self._store:
+            np.random.shuffle(mat)          # re-mix rows within the bucket
+            pad_col = np.full((mat.shape[0], 1), self.invalid_label,
+                              dtype=mat.dtype)
+            labels = np.concatenate([mat[:, 1:], pad_col], axis=1)
+            self._device.append((ndarray.array(mat, dtype=self.dtype),
+                                 ndarray.array(labels, dtype=self.dtype)))
 
     def next(self):
-        if self.curr_idx == len(self.idx):
+        if self._cursor >= len(self._batches):
             raise StopIteration
-        i, j = self.idx[self.curr_idx]
-        self.curr_idx += 1
+        bucket, ofs = self._batches[self._order[self._cursor]]
+        self._cursor += 1
+        rows = slice(ofs, ofs + self.batch_size)
+        dat, lab = self._device[bucket]
         if self.major_axis == 1:
-            data = ndarray.NDArray(
-                self.nddata[i].data[j:j + self.batch_size].T)
-            label = ndarray.NDArray(
-                self.ndlabel[i].data[j:j + self.batch_size].T)
+            dat = ndarray.NDArray(dat.data[rows].T)
+            lab = ndarray.NDArray(lab.data[rows].T)
         else:
-            data = self.nddata[i][j:j + self.batch_size]
-            label = self.ndlabel[i][j:j + self.batch_size]
-        return DataBatch([data], [label], pad=0,
-                         bucket_key=self.buckets[i],
-                         provide_data=[DataDesc(self.data_name, data.shape)],
-                         provide_label=[DataDesc(self.label_name,
-                                                 label.shape)])
+            dat, lab = dat[rows], lab[rows]
+        return DataBatch(
+            [dat], [lab], pad=0, bucket_key=self.buckets[bucket],
+            provide_data=[DataDesc(self.data_name, dat.shape,
+                                   layout=self.layout)],
+            provide_label=[DataDesc(self.label_name, lab.shape,
+                                    layout=self.layout)])

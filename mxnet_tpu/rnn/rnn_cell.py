@@ -14,51 +14,64 @@ from .. import symbol
 from ..base import MXNetError
 
 
-def _cells_state_shape(cells):
-    return sum([c.state_shape for c in cells], [])
-
-
-def _cells_state_info(cells):
-    return sum([c.state_info for c in cells], [])
-
-
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
-
-
-def _cells_unpack_weights(cells, args):
-    for cell in cells:
-        args = cell.unpack_weights(args)
-    return args
-
-
-def _cells_pack_weights(cells, args):
-    for cell in cells:
-        args = cell.pack_weights(args)
-    return args
-
-
-def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
+def _split_steps(inputs, length, layout, in_layout=None):
+    """Turn a sequence tensor into per-step symbols (a list passes
+    through after a length check).  Returns ``(steps, t_axis)`` where
+    ``t_axis`` is the time axis of ``layout``."""
     assert inputs is not None
-    axis = layout.find("T")
-    in_axis = in_layout.find("T") if in_layout is not None else axis
+    t_axis = layout.find("T")
+    src_axis = (in_layout or layout).find("T")
     if isinstance(inputs, symbol.Symbol):
-        if merge is False:
-            assert len(inputs.list_outputs()) == 1, \
-                "unroll doesn't allow grouped symbol as input. Please " \
-                "convert to list first or let unroll handle splitting"
-            inputs = list(symbol.SliceChannel(inputs, axis=in_axis,
-                                              num_outputs=length,
-                                              squeeze_axis=1))
+        assert len(inputs.list_outputs()) == 1, \
+            "unroll doesn't allow grouped symbol as input. Please " \
+            "convert to list first or let unroll handle splitting"
+        steps = list(symbol.SliceChannel(inputs, axis=src_axis,
+                                         num_outputs=length,
+                                         squeeze_axis=1))
     else:
         assert length is None or len(inputs) == length
-        if merge is True:
-            inputs = [symbol.expand_dims(i, axis=axis) for i in inputs]
-            inputs = symbol.Concat(*inputs, dim=axis)
-            in_axis = axis
-    if isinstance(inputs, symbol.Symbol) and axis != in_axis:
-        inputs = symbol.swapaxes(inputs, dim1=axis, dim2=in_axis)
-    return inputs, axis
+        steps = inputs
+    return steps, t_axis
+
+
+def _stack_steps(steps, t_axis):
+    """Inverse of :func:`_split_steps`: one tensor with a time axis."""
+    widened = [symbol.expand_dims(s, axis=t_axis) for s in steps]
+    return symbol.Concat(*widened, dim=t_axis)
+
+
+class _CompoundCell(object):
+    """Plumbing shared by cells wrapping a list of children: state
+    bookkeeping and weight (un)packing chain through the children in
+    order."""
+
+    @property
+    def state_info(self):
+        return [info for c in self._cells for info in c.state_info]
+
+    def begin_state(self, **kwargs):
+        assert not self._modified
+        return [s for c in self._cells for s in c.begin_state(**kwargs)]
+
+    def unpack_weights(self, args):
+        for cell in self._cells:
+            args = cell.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for cell in self._cells:
+            args = cell.pack_weights(args)
+        return args
+
+    def _adopt_params(self, children, override):
+        if override:
+            for child in children:
+                assert child._own_params, \
+                    "Either specify params for the compound cell or its " \
+                    "children, not both."
+                child.params._params.update(self.params._params)
+        for child in children:
+            self.params._params.update(child.params._params)
 
 
 class RNNParams(object):
@@ -130,64 +143,69 @@ class BaseRNNCell(object):
         return states
 
     def unpack_weights(self, args):
-        """Unpack fused weight matrices into separate gate weights
-        (reference ``rnn_cell.py:181``)."""
-        args = args.copy()
+        """Split the fused per-direction weight/bias matrices into one
+        entry per gate (checkpoint-name compatible with the reference's
+        cuDNN parameter layout)."""
         if not self._gate_names:
-            return args
+            return args.copy()
+        out = dict(args)
         h = self._num_hidden
-        for group_name in ["i2h", "h2h"]:
-            weight = args.pop("%s%s_weight" % (self._prefix, group_name))
-            bias = args.pop("%s%s_bias" % (self._prefix, group_name))
+        for part in ("i2h", "h2h"):
+            fused_w = out.pop(self._prefix + part + "_weight")
+            fused_b = out.pop(self._prefix + part + "_bias")
             for j, gate in enumerate(self._gate_names):
-                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
-                args[wname] = weight[j * h:(j + 1) * h].copy()
-                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
-                args[bname] = bias[j * h:(j + 1) * h].copy()
-        return args
+                rows = slice(j * h, (j + 1) * h)
+                out[self._prefix + part + gate + "_weight"] = \
+                    fused_w[rows].copy()
+                out[self._prefix + part + gate + "_bias"] = \
+                    fused_b[rows].copy()
+        return out
 
     def pack_weights(self, args):
-        """Pack gate weights into fused matrices
-        (reference ``rnn_cell.py:201``)."""
-        from .. import ndarray
-        args = args.copy()
+        """Inverse of :meth:`unpack_weights`."""
         if not self._gate_names:
-            return args
-        for group_name in ["i2h", "h2h"]:
-            weight = []
-            bias = []
-            for gate in self._gate_names:
-                wname = "%s%s%s_weight" % (self._prefix, group_name, gate)
-                weight.append(args.pop(wname))
-                bname = "%s%s%s_bias" % (self._prefix, group_name, gate)
-                bias.append(args.pop(bname))
-            args["%s%s_weight" % (self._prefix, group_name)] = \
-                ndarray.concatenate(weight)
-            args["%s%s_bias" % (self._prefix, group_name)] = \
-                ndarray.concatenate(bias)
-        return args
+            return args.copy()
+        from ..ndarray import concatenate
+        out = dict(args)
+        for part in ("i2h", "h2h"):
+            for kind in ("weight", "bias"):
+                pieces = [out.pop("%s%s%s_%s" % (self._prefix, part, g, kind))
+                          for g in self._gate_names]
+                out["%s%s_%s" % (self._prefix, part, kind)] = \
+                    concatenate(pieces)
+        return out
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
-        """Unroll the recurrence for ``length`` steps
-        (reference ``rnn_cell.py:221-295``)."""
+        """Step the cell ``length`` times; with ``merge_outputs`` the
+        per-step outputs come back stacked along the time axis."""
         self.reset()
-        inputs, _ = _normalize_sequence(length, inputs, layout, False)
-        if begin_state is None:
-            begin_state = self.begin_state()
-        states = begin_state
+        steps, t_axis = _split_steps(inputs, length, layout)
+        states = begin_state if begin_state is not None \
+            else self.begin_state()
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
-        outputs, _ = _normalize_sequence(length, outputs, layout,
-                                         merge_outputs)
+        for step_input in steps:
+            out, states = self(step_input, states)
+            outputs.append(out)
+        if merge_outputs:
+            outputs = _stack_steps(outputs, t_axis)
         return outputs, states
 
     def _get_activation(self, inputs, activation, **kwargs):
         if isinstance(activation, str):
             return symbol.Activation(inputs, act_type=activation, **kwargs)
         return activation(inputs, **kwargs)
+
+    def _projections(self, step_name, inputs, prev_h, num_gates, sep=""):
+        """The two dense projections every gate stack is built from."""
+        width = self._num_hidden * num_gates
+        i2h = symbol.FullyConnected(
+            data=inputs, weight=self._iW, bias=self._iB, num_hidden=width,
+            name="%s%si2h" % (step_name, sep))
+        h2h = symbol.FullyConnected(
+            data=prev_h, weight=self._hW, bias=self._hB, num_hidden=width,
+            name="%s%sh2h" % (step_name, sep))
+        return i2h, h2h
 
 
 class RNNCell(BaseRNNCell):
@@ -214,14 +232,7 @@ class RNNCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden,
-                                    name="%sh2h" % name)
+        i2h, h2h = self._projections(name, inputs, states[0], 1)
         output = self._get_activation(i2h + h2h, self._activation,
                                       name="%sout" % name)
         return output, [output]
@@ -253,29 +264,17 @@ class LSTMCell(BaseRNNCell):
     def __call__(self, inputs, states):
         self._counter += 1
         name = "%st%d_" % (self._prefix, self._counter)
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%si2h" % name)
-        h2h = symbol.FullyConnected(data=states[0], weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 4,
-                                    name="%sh2h" % name)
-        gates = i2h + h2h
-        slice_gates = symbol.SliceChannel(gates, num_outputs=4,
-                                          name="%sslice" % name)
-        in_gate = symbol.Activation(slice_gates[0], act_type="sigmoid",
-                                    name="%si" % name)
-        forget_gate = symbol.Activation(slice_gates[1], act_type="sigmoid",
-                                        name="%sf" % name)
-        in_transform = symbol.Activation(slice_gates[2], act_type="tanh",
-                                         name="%sc" % name)
-        out_gate = symbol.Activation(slice_gates[3], act_type="sigmoid",
-                                     name="%so" % name)
-        next_c = symbol._plus(forget_gate * states[1],
-                              in_gate * in_transform,
+        i2h, h2h = self._projections(name, inputs, states[0], 4)
+        pieces = symbol.SliceChannel(i2h + h2h, num_outputs=4,
+                                     name="%sslice" % name)
+        gate = {tag: symbol.Activation(
+                    pieces[j], act_type=act, name="%s%s" % (name, tag))
+                for j, (tag, act) in enumerate(
+                    [("i", "sigmoid"), ("f", "sigmoid"),
+                     ("c", "tanh"), ("o", "sigmoid")])}
+        next_c = symbol._plus(gate["f"] * states[1], gate["i"] * gate["c"],
                               name="%sstate" % name)
-        next_h = symbol._mul(out_gate,
+        next_h = symbol._mul(gate["o"],
                              symbol.Activation(next_c, act_type="tanh"),
                              name="%sout" % name)
         return next_h, [next_h, next_c]
@@ -302,30 +301,20 @@ class GRUCell(BaseRNNCell):
 
     def __call__(self, inputs, states):
         self._counter += 1
-        seq_idx = self._counter
-        name = "%st%d_" % (self._prefix, seq_idx)
-        prev_state_h = states[0]
-        i2h = symbol.FullyConnected(data=inputs, weight=self._iW,
-                                    bias=self._iB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%s_i2h" % name)
-        h2h = symbol.FullyConnected(data=prev_state_h, weight=self._hW,
-                                    bias=self._hB,
-                                    num_hidden=self._num_hidden * 3,
-                                    name="%s_h2h" % name)
-        i2h_r, i2h_z, i2h = symbol.SliceChannel(i2h, num_outputs=3,
-                                                name="%s_i2h_slice" % name)
-        h2h_r, h2h_z, h2h = symbol.SliceChannel(h2h, num_outputs=3,
-                                                name="%s_h2h_slice" % name)
-        reset_gate = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
-                                       name="%s_r_act" % name)
-        update_gate = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
-                                        name="%s_z_act" % name)
-        next_h_tmp = symbol.Activation(i2h + reset_gate * h2h,
-                                       act_type="tanh",
-                                       name="%s_h_act" % name)
-        next_h = symbol._plus((1. - update_gate) * next_h_tmp,
-                              update_gate * prev_state_h,
+        name = "%st%d_" % (self._prefix, self._counter)
+        prev_h = states[0]
+        i2h, h2h = self._projections(name, inputs, prev_h, 3, sep="_")
+        i2h_r, i2h_z, i2h = symbol.SliceChannel(
+            i2h, num_outputs=3, name="%s_i2h_slice" % name)
+        h2h_r, h2h_z, h2h = symbol.SliceChannel(
+            h2h, num_outputs=3, name="%s_h2h_slice" % name)
+        reset = symbol.Activation(i2h_r + h2h_r, act_type="sigmoid",
+                                  name="%s_r_act" % name)
+        update = symbol.Activation(i2h_z + h2h_z, act_type="sigmoid",
+                                   name="%s_z_act" % name)
+        candidate = symbol.Activation(i2h + reset * h2h, act_type="tanh",
+                                      name="%s_h_act" % name)
+        next_h = symbol._plus((1. - update) * candidate, update * prev_h,
                               name="%sout" % name)
         return next_h, [next_h]
 
@@ -409,8 +398,9 @@ class FusedRNNCell(BaseRNNCell):
         return self._stack
 
 
-class SequentialRNNCell(BaseRNNCell):
-    """Stack multiple cells (reference ``rnn_cell.py:685``)."""
+class SequentialRNNCell(_CompoundCell, BaseRNNCell):
+    """Stack cells so each feeds the next (reference
+    ``rnn_cell.py:685``)."""
 
     def __init__(self, params=None):
         super().__init__(prefix="", params=params)
@@ -419,58 +409,39 @@ class SequentialRNNCell(BaseRNNCell):
 
     def add(self, cell):
         self._cells.append(cell)
-        if self._override_cell_params:
-            assert cell._own_params, \
-                "Either specify params for SequentialRNNCell or child cells, " \
-                "not both."
-            cell.params._params.update(self.params._params)
-        self.params._params.update(cell.params._params)
+        self._adopt_params([cell], self._override_cell_params)
 
-    @property
-    def state_info(self):
-        return _cells_state_info(self._cells)
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._cells, **kwargs)
-
-    def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
-
-    def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
+    def _chunk_states(self, states):
+        """Pair each child with its slice of the flat state list."""
+        at = 0
+        for cell in self._cells:
+            width = len(cell.state_info)
+            yield cell, states[at:at + width]
+            at += width
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
-        for cell in self._cells:
+        collected = []
+        for cell, chunk in self._chunk_states(states):
             assert not isinstance(cell, BidirectionalCell)
-            n = len(cell.state_info)
-            state = states[p:p + n]
-            p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+            inputs, chunk = cell(inputs, chunk)
+            collected.extend(chunk)
+        return inputs, collected
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        num_cells = len(self._cells)
         if begin_state is None:
             begin_state = self.begin_state()
-        p = 0
-        next_states = []
-        outputs = inputs
-        for i, cell in enumerate(self._cells):
-            n = len(cell.state_info)
-            states = begin_state[p:p + n]
-            p += n
-            outputs, states = cell.unroll(
-                length, inputs=outputs, begin_state=states, layout=layout,
-                merge_outputs=None if i < num_cells - 1 else merge_outputs)
-            next_states.extend(states)
-        return outputs, next_states
+        seq = inputs
+        final_states = []
+        last = len(self._cells) - 1
+        for i, (cell, chunk) in enumerate(self._chunk_states(begin_state)):
+            seq, chunk = cell.unroll(
+                length, inputs=seq, begin_state=chunk, layout=layout,
+                merge_outputs=merge_outputs if i == last else None)
+            final_states.extend(chunk)
+        return seq, final_states
 
 
 class DropoutCell(BaseRNNCell):
@@ -571,63 +542,38 @@ class ResidualCell(ModifierCell):
         return output, states
 
 
-class BidirectionalCell(BaseRNNCell):
-    """Run two cells in opposite directions (reference
-    ``rnn_cell.py:881``)."""
+class BidirectionalCell(_CompoundCell, BaseRNNCell):
+    """Run a forward and a backward cell over the sequence and
+    concatenate their per-step outputs (reference ``rnn_cell.py:881``)."""
 
     def __init__(self, l_cell, r_cell, params=None, output_prefix="bi_"):
         super().__init__("", params=params)
         self._output_prefix = output_prefix
-        self._override_cell_params = params is not None
-        if self._override_cell_params:
-            assert l_cell._own_params and r_cell._own_params, \
-                "Either specify params for BidirectionalCell or child " \
-                "cells, not both."
-            l_cell.params._params.update(self.params._params)
-            r_cell.params._params.update(self.params._params)
-        self.params._params.update(l_cell.params._params)
-        self.params._params.update(r_cell.params._params)
+        self._adopt_params([l_cell, r_cell], params is not None)
         self._cells = [l_cell, r_cell]
-
-    def unpack_weights(self, args):
-        return _cells_unpack_weights(self._cells, args)
-
-    def pack_weights(self, args):
-        return _cells_pack_weights(self._cells, args)
 
     def __call__(self, inputs, states):
         raise MXNetError("Bidirectional cannot be stepped. Please use unroll")
 
-    @property
-    def state_info(self):
-        return _cells_state_info(self._cells)
-
-    def begin_state(self, **kwargs):
-        assert not self._modified
-        return _cells_begin_state(self._cells, **kwargs)
-
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None):
         self.reset()
-        inputs, axis = _normalize_sequence(length, inputs, layout, False)
+        steps, t_axis = _split_steps(inputs, length, layout)
         if begin_state is None:
             begin_state = self.begin_state()
-        states = begin_state
-        l_cell, r_cell = self._cells
-        l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info)], layout=layout,
+        fwd_cell, bwd_cell = self._cells
+        split_at = len(fwd_cell.state_info)
+        fwd_out, fwd_states = fwd_cell.unroll(
+            length, inputs=steps, begin_state=begin_state[:split_at],
+            layout=layout, merge_outputs=False)
+        bwd_out, bwd_states = bwd_cell.unroll(
+            length, inputs=list(reversed(steps)),
+            begin_state=begin_state[split_at:], layout=layout,
             merge_outputs=False)
-        r_outputs, r_states = r_cell.unroll(
-            length, inputs=list(reversed(inputs)),
-            begin_state=states[len(l_cell.state_info):], layout=layout,
-            merge_outputs=False)
-        outputs = [symbol.Concat(l_o, r_o, dim=1,
+        outputs = [symbol.Concat(f, b, dim=1,
                                  name="%st%d" % (self._output_prefix, i))
-                   for i, (l_o, r_o) in enumerate(
-                       zip(l_outputs, reversed(r_outputs)))]
+                   for i, (f, b) in enumerate(zip(fwd_out,
+                                                  reversed(bwd_out)))]
         if merge_outputs:
-            outputs = [symbol.expand_dims(i, axis=axis) for i in outputs]
-            outputs = symbol.Concat(*outputs, dim=axis)
-        states = l_states + r_states
-        return outputs, states
+            outputs = _stack_steps(outputs, t_axis)
+        return outputs, fwd_states + bwd_states

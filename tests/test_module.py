@@ -138,6 +138,17 @@ def test_module_auto_fused_predict(monkeypatch):
     assert out.shape == (200, 2)
 
 
+def test_module_fused_fallback_unfusable_optimizer(monkeypatch):
+    """Optimizers without a pure fused rule (SGLD) fall back to the
+    classic executor path instead of crashing init_optimizer."""
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "always")
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.fit(train, num_epoch=1, optimizer="sgld",
+            optimizer_params={"learning_rate": 0.01})
+    assert mod._trainer is None and mod._exec_group is not None
+
+
 def test_module_optimizer_state_roundtrip(tmp_path):
     train = _toy_data()
     mod = Module(_softmax_mlp(), context=mx.cpu())

@@ -1,0 +1,55 @@
+"""Similarity check vs the reference: strips comments/docstrings,
+normalizes whitespace, and reports (SequenceMatcher ratio, fraction of
+our lines appearing verbatim in the reference file).  Used to keep the
+host-side API layer an original implementation rather than a transplant.
+"""
+import ast
+import difflib
+import io
+import re
+import sys
+import tokenize
+
+
+def strip_code(path):
+    src = open(path).read()
+    out = []
+    try:
+        toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    except Exception:
+        return []
+    drop = {tokenize.COMMENT, tokenize.NL}
+    prev_end = (1, 0)
+    lines = {}
+    for tok in toks:
+        if tok.type in drop:
+            continue
+        if tok.type == tokenize.STRING:
+            # docstring heuristic: an expression-statement string
+            stripped = tok.line.strip()
+            if stripped.startswith(('"""', "'''", 'r"""', "u'''", '"',
+                                    "'")) and stripped == tok.string.strip():
+                continue
+        if tok.type in (tokenize.NEWLINE, tokenize.INDENT,
+                        tokenize.DEDENT, tokenize.ENDMARKER):
+            continue
+        row = tok.start[0]
+        lines.setdefault(row, []).append(tok.string)
+    return [re.sub(r"\s+", " ", " ".join(v)).strip()
+            for _, v in sorted(lines.items()) if v]
+
+
+def compare(ours, theirs):
+    a, b = strip_code(ours), strip_code(theirs)
+    if not a or not b:
+        return 0.0, 0.0
+    ratio = difflib.SequenceMatcher(None, a, b).ratio()
+    bset = set(b)
+    verbatim = sum(1 for ln in a if ln in bset and len(ln) > 10) / len(a)
+    return ratio, verbatim
+
+
+if __name__ == "__main__":
+    ours, theirs = sys.argv[1], sys.argv[2]
+    r, v = compare(ours, theirs)
+    print("%s vs %s: ratio=%.2f verbatim=%.2f" % (ours, theirs, r, v))
