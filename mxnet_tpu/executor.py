@@ -35,6 +35,16 @@ from .symbol import Symbol, _topo
 __all__ = ["Executor", "bind", "simple_bind"]
 
 
+def _jax_device_for(ctx):
+    """Map a Context onto a concrete jax device (a tpu Context degrades
+    to the default backend when no TPU platform is visible)."""
+    try:
+        devs = jax.devices(ctx.device_type)
+    except RuntimeError:
+        devs = jax.devices()
+    return devs[ctx.device_id % len(devs)]
+
+
 class _GraphProgram:
     """The compiled form of a Symbol: pure fn + metadata."""
 
@@ -49,6 +59,14 @@ class _GraphProgram:
         self._aux_index = {n: i for i, n in enumerate(self.aux_names)}
         self.has_rng = any((not n.is_variable) and n.op.uses_rng
                            for n in self.nodes)
+        # target backend for platform-specialized op lowerings
+        self.platform = None
+        # group2ctx placement: node name -> jax device.  The TPU analog
+        # of the reference's PlaceDevice pass + _CrossDeviceCopy insertion
+        # (src/executor/graph_executor.cc:241-318): inside the single
+        # jitted program, a node with a placement gets its outputs pinned
+        # with jax.device_put; XLA inserts the cross-device transfers.
+        self.placement = {}
         self._jitted = {}
 
     # ------------------------------------------------------------------
@@ -69,8 +87,12 @@ class _GraphProgram:
             rng = None
             if n.op.uses_rng:
                 rng = jax.random.fold_in(rng_key, len(env))
-            ctx = OpContext(is_train=is_train, rng=rng)
+            ctx = OpContext(is_train=is_train, rng=rng,
+                            platform=self.platform)
             outs, aux_updates = n.op.apply(n.params, ctx, *(in_vals + node_aux))
+            dev = self.placement.get(n.name)
+            if dev is not None:
+                outs = tuple(jax.device_put(o, dev) for o in outs)
             for i, v in enumerate(outs):
                 env[(id(n), i)] = v
                 if monitor is not None:
@@ -103,6 +125,15 @@ class Executor:
         self.grad_dict = args_grad or {}
         self.aux_dict = aux_states
         self.arg_arrays = [args[n] for n in self._prog.arg_names]
+        # platform for backend-specialized lowerings: taken from where the
+        # bound arrays actually live (a tpu Context degrades to the host
+        # backend when no TPU is visible, e.g. the CPU test mesh)
+        try:
+            plat = next(iter(self.arg_arrays[0].data.devices())).platform
+        except Exception:
+            plat = jax.default_backend()
+        self._prog.platform = "tpu" if plat in ("tpu", "axon") else plat
+
         self.grad_arrays = [self.grad_dict.get(n) for n in self._prog.arg_names]
         self.aux_arrays = [aux_states[n] for n in self._prog.aux_names]
         if isinstance(grad_req, str):
@@ -111,6 +142,16 @@ class Executor:
             grad_req = dict(zip(self._prog.arg_names, grad_req))
         self.grad_req = grad_req
         self._group2ctx = group2ctx or {}
+        if self._group2ctx:
+            attrs = sym.attr_dict()
+            for n in self._prog.nodes:
+                if n.is_variable:
+                    continue
+                group = (getattr(n, "attrs", None) or {}).get("ctx_group") \
+                    or attrs.get(n.name, {}).get("ctx_group")
+                if group in self._group2ctx:
+                    self._prog.placement[n.name] = \
+                        _jax_device_for(self._group2ctx[group])
         self._outputs: List[NDArray] = []
         self._vjp = None
         self._monitor = None
@@ -265,7 +306,10 @@ class Executor:
             if n.is_variable:
                 lines.append("Variable:%s" % n.name)
             else:
-                lines.append("Op:%s, Name=%s" % (n.op.name, n.name))
+                where = self._prog.placement.get(n.name)
+                lines.append("Op:%s, Name=%s%s" % (
+                    n.op.name, n.name,
+                    ", Device=%s" % where if where is not None else ""))
         return "\n".join(lines)
 
 

@@ -27,7 +27,10 @@ def _dot_product_attention(p, c, q, k, v):
     scale = None if p["scale"] <= 0 else p["scale"]
     if p["flash"]:
         from .pallas import flash_attention
+        plat = c.platform or jax.default_backend()
+        interpret = plat not in ("tpu", "axon")
         return flash_attention(q, k, v, causal=p["causal"], scale=scale,
-                               block_q=p["block_q"], block_k=p["block_k"])
+                               block_q=p["block_q"], block_k=p["block_k"],
+                               interpret=interpret)
     from ..parallel.ring_attention import attention_reference
     return attention_reference(q, k, v, causal=p["causal"], scale=scale)

@@ -41,13 +41,18 @@ class OpContext:
     ``rng`` is a JAX PRNG key for ops that declared ``uses_rng`` — the
     functional replacement of ``ResourceRequest::kRandom``
     (``include/mxnet/resource.h:18-36``).
+    ``platform`` is the target backend of the executor/trainer that is
+    tracing this op ("tpu"/"cpu"/...; None = process default) — ops with
+    backend-specialized kernels (Pallas flash attention) select their
+    lowering with it.
     """
 
-    __slots__ = ("is_train", "rng")
+    __slots__ = ("is_train", "rng", "platform")
 
-    def __init__(self, is_train=False, rng=None):
+    def __init__(self, is_train=False, rng=None, platform=None):
         self.is_train = is_train
         self.rng = rng
+        self.platform = platform
 
 
 def _parse_bool(v):

@@ -55,6 +55,12 @@ class Trainer:
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
+        if mesh is not None:
+            plat = mesh.devices.flat[0].platform
+        else:
+            import jax as _jax
+            plat = _jax.default_backend()
+        self.prog.platform = "tpu" if plat in ("tpu", "axon") else plat
         self.data_names = list(data_names)
         self.label_names = [n for n in label_names
                             if n in self.prog.arg_names]

@@ -1,73 +1,95 @@
 #!/usr/bin/env python
-"""Cross-backend consistency: the reference re-runs its op tests on GPU
-and asserts CPU/GPU executors match (``tests/python/gpu/
-test_operator_gpu.py`` + ``check_consistency``, SURVEY §4).  The TPU
-analog: the same symbol bound on host-CPU jax and on the TPU backend
-must produce matching outputs and input gradients.
+"""Cross-backend consistency, registry-wide.
+
+The reference re-runs its op tests on GPU and asserts CPU/GPU executors
+match (``tests/python/gpu/test_operator_gpu.py`` + ``check_consistency``,
+SURVEY §4).  The TPU analog iterates the SAME case table as the
+registry-wide sweep (``tests/test_op_sweep.py`` — every registered op +
+alias has a case): each case's symbol is bound with identical inputs on
+the host-CPU jax backend and on the TPU backend; outputs (and, for
+differentiable cases, input gradients) must match.
 
 Run standalone (needs the TPU default backend visible):
 
-    python tests/nightly/consistency.py
+    python tests/nightly/consistency.py            # full registry
+    python tests/nightly/consistency.py --sample 6 # every 6th case (CI)
 """
+import argparse
 import os
 import sys
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                os.pardir, os.pardir))
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_HERE, os.pardir, os.pardir))
+sys.path.insert(0, os.path.join(_HERE, os.pardir))
 
 import numpy as np
 
 
+def _run_case(mx, case, build, ctx, want_grads):
+    sym, aux = build(case)
+    args = {k: mx.nd.array(v, ctx=ctx) for k, v in case["loc"].items()}
+    aux_states = {k: mx.nd.array(v, ctx=ctx) for k, v in (aux or {}).items()}
+    grads = None
+    if want_grads:
+        grads = {k: mx.nd.zeros(v.shape, ctx=ctx)
+                 for k, v in case["loc"].items()}
+    exe = sym.bind(ctx, args=args, args_grad=grads,
+                   aux_states=aux_states or None)
+    exe.forward(is_train=want_grads)
+    outs = [o.asnumpy() for o in exe.outputs]
+    grad_vals = {}
+    if want_grads:
+        exe.backward([mx.nd.ones(o.shape, ctx=ctx) for o in exe.outputs])
+        names = case["grad_nodes"] or list(case["loc"])
+        grad_vals = {k: exe.grad_dict[k].asnumpy() for k in names}
+    return outs, grad_vals
+
+
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sample", type=int, default=1,
+                    help="run every Nth case (1 = all)")
+    opts = ap.parse_args()
     import jax
     import mxnet_tpu as mx
-    from mxnet_tpu.test_utils import check_consistency
+    import test_op_sweep as sweep
+    from mxnet_tpu.op import registry as _registry
 
     if jax.devices()[0].platform not in ("tpu", "axon"):
         print("SKIP: no TPU backend visible")
         return 0
+    # f32 convs/matmuls on TPU default to bf16 MXU passes; raise precision
+    # so the cross-backend comparison tests math, not rounding mode
+    jax.config.update("jax_default_matmul_precision", "highest")
 
-    np.random.seed(0)
-    x = mx.sym.Variable("data")
-    w = mx.sym.Variable("w")
-    cases = [
-        ("fc", mx.sym.FullyConnected(x, num_hidden=8), (4, 16)),
-        ("conv", mx.sym.Convolution(x, kernel=(3, 3), num_filter=4,
-                                    pad=(1, 1)), (2, 3, 8, 8)),
-        ("pool", mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2),
-                                pool_type="max"), (2, 3, 8, 8)),
-        ("bn", mx.sym.BatchNorm(x, fix_gamma=False), (4, 3, 5, 5)),
-        ("act", mx.sym.Activation(x, act_type="tanh"), (4, 7)),
-        ("softmax", mx.sym.softmax(x), (4, 9)),
-        ("ln", mx.sym.LayerNorm(x, mx.sym.Variable("g"),
-                                mx.sym.Variable("b")), (4, 6)),
-        ("elemwise", mx.sym.sqrt(mx.sym.abs(x) + 1.0) * 2.0, (3, 5)),
-        ("dot", mx.sym.dot(x, w), {"data": (4, 6), "w": (6, 3)}),
-        ("reduce", mx.sym.sum(x, axis=1), (3, 7)),
-        ("transpose", mx.sym.transpose(x, axes=(1, 0)), (3, 4)),
-        ("embed+take", mx.sym.Embedding(x, input_dim=11, output_dim=5),
-         (4, 3)),
-        ("lrn", mx.sym.LRN(x, nsize=3), (2, 6, 4, 4)),
-        ("upsample", mx.sym.UpSampling(x, scale=2, sample_type="nearest"),
-         (1, 2, 4, 4)),
-    ]
-    failures = []
-    for name, sym, shape in cases:
-        shapes = shape if isinstance(shape, dict) else {"data": shape}
-        ctx_list = [dict(ctx=mx.cpu(), **shapes),
-                    dict(ctx=mx.tpu(), **shapes)]
-        grad_req = "null" if name == "embed+take" else "write"
+    ran = failures = 0
+    for idx, case in enumerate(sweep.CASES):
+        if idx % opts.sample:
+            continue
+        op = _registry.get(case["op"])
+        if op.uses_rng and case["params"].get("p") != 0.0:
+            continue                     # sampler draws are backend-keyed
+        want_grads = case["kind"] == "grad"
         try:
-            check_consistency(sym, ctx_list, grad_req=grad_req, tol=2e-2)
-            print("OK  %s" % name)
-        except Exception as e:                       # noqa: BLE001
-            failures.append((name, e))
-            print("FAIL %s: %s" % (name, e))
-    if failures:
-        return 1
-    print("cpu-vs-tpu consistency: %d/%d ops match" % (len(cases),
-                                                       len(cases)))
-    return 0
+            cpu_out, cpu_grad = _run_case(mx, case, sweep._build_symbol,
+                                          mx.cpu(), want_grads)
+            tpu_out, tpu_grad = _run_case(mx, case, sweep._build_symbol,
+                                          mx.tpu(), want_grads)
+            for a, b in zip(cpu_out, tpu_out):
+                np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-3)
+            for k in cpu_grad:
+                np.testing.assert_allclose(cpu_grad[k], tpu_grad[k],
+                                           rtol=2e-2, atol=2e-3,
+                                           err_msg="grad %s" % k)
+            ran += 1
+        except Exception as e:                        # noqa: BLE001
+            failures += 1
+            print("FAIL %-32s %s" % (case["id"], str(e)[:200]))
+    print("cpu-vs-tpu consistency: %d cases matched, %d failed "
+          "(registry: %d ops + %d aliases)" %
+          (ran, failures, len(_registry._REGISTRY),
+           len(_registry._ALIASES)))
+    return 1 if failures else 0
 
 
 if __name__ == "__main__":

@@ -41,10 +41,11 @@ def test_cpu_tpu_consistency():
     env.pop("JAX_PLATFORMS", None)       # let the default backend load
     res = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tests", "nightly",
-                                      "consistency.py")],
+                                      "consistency.py"), "--sample", "6"],
         capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
     assert res.returncode == 0, res.stdout + res.stderr
     import re
-    m = re.search(r"consistency: (\d+)/(\d+) ops match", res.stdout)
-    assert (m and m.group(1) == m.group(2)) or "SKIP" in res.stdout, \
-        res.stdout
+    m = re.search(r"consistency: (\d+) cases matched, (\d+) failed",
+                  res.stdout)
+    assert (m and int(m.group(1)) > 30 and m.group(2) == "0") \
+        or "SKIP" in res.stdout, res.stdout
