@@ -95,9 +95,10 @@ def _convolution(p, c, data, weight, bias=None):
     stride = _conv_tuple(p["stride"], nd)
     dilate = _conv_tuple(p["dilate"], nd)
     pad = _conv_tuple(p["pad"], nd, 0)
+    channels_last = _channels_last(p.get("layout"), nd)
     dn = lax.conv_dimension_numbers(
         data.shape, weight.shape,
-        _conv_dimnums(nd))
+        _conv_dimnums(nd, channels_last))
     out = lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(q, q) for q in pad], rhs_dilation=dilate,
@@ -106,15 +107,35 @@ def _convolution(p, c, data, weight, bias=None):
     if out.dtype != data.dtype:
         out = out.astype(data.dtype)
     if bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if channels_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
-def _conv_dimnums(nd):
-    # NCHW/OIHW layout family (the reference's only CPU layout)
+def _channels_last(layout, nd):
+    """The reference's ``layout`` param ("NCHW"/"NHWC"/"NCW"/"NWC"/
+    "NCDHW"/"NDHWC").  Channels-last is the TPU-preferred layout: lanes
+    map to channels, so XLA tiles the conv onto the MXU without the
+    internal relayout-transposes NCHW needs."""
+    if layout is None:
+        return False
+    layout = layout.upper()
+    if layout in ("NCW", "NCHW", "NCDHW"):
+        return False
+    if layout in ("NWC", "NHWC", "NDHWC"):
+        return True
+    raise MXNetError("unsupported convolution layout %s" % layout)
+
+
+def _conv_dimnums(nd, channels_last=False):
     spatial = "DHW"[-nd:] if nd <= 3 else None
     if spatial is None:
         raise MXNetError("Convolution supports 1-3 spatial dims")
+    if channels_last:
+        # data N..C, weight ..IO (HWIO): the native TPU convolution layout
+        return ("N" + spatial + "C", spatial + "IO", "N" + spatial + "C")
+    # NCHW/OIHW layout family (the reference's only CPU layout)
     return ("NC" + spatial, "OI" + spatial, "NC" + spatial)
 
 
@@ -123,18 +144,27 @@ def _conv_infer_shape(p, in_shapes):
     if dshape is None or 0 in dshape:
         return None
     nd = len(p["kernel"])
-    cin = dshape[1]
-    wshape = (p["num_filter"], cin // p["num_group"]) + tuple(p["kernel"])
+    channels_last = _channels_last(p.get("layout"), nd)
+    cin = dshape[-1] if channels_last else dshape[1]
+    if channels_last:
+        wshape = tuple(p["kernel"]) + (cin // p["num_group"],
+                                       p["num_filter"])
+        in_sp = dshape[1:-1]
+    else:
+        wshape = (p["num_filter"], cin // p["num_group"]) + tuple(p["kernel"])
+        in_sp = dshape[2:]
     stride = _conv_tuple(p["stride"], nd)
     dilate = _conv_tuple(p["dilate"], nd)
     pad = _conv_tuple(p["pad"], nd, 0)
     out_sp = tuple(
-        (dshape[2 + i] + 2 * pad[i] - (dilate[i] * (p["kernel"][i] - 1) + 1))
+        (in_sp[i] + 2 * pad[i] - (dilate[i] * (p["kernel"][i] - 1) + 1))
         // stride[i] + 1 for i in range(nd))
     shapes = [tuple(dshape), wshape]
     if not p["no_bias"]:
         shapes.append((p["num_filter"],))
-    return shapes, [(dshape[0], p["num_filter"]) + out_sp], []
+    out = (dshape[0],) + out_sp + (p["num_filter"],) if channels_last \
+        else (dshape[0], p["num_filter"]) + out_sp
+    return shapes, [out], []
 
 
 @register("Deconvolution",
@@ -147,6 +177,12 @@ def _deconvolution(p, c, data, weight, bias=None):
     # which lax.conv_transpose does not).  weight layout (Cin, Cout/g, *k)
     # mirrors the reference (deconv reuses Convolution's weight transposed).
     nd = len(p["kernel"])
+    channels_last = _channels_last(p.get("layout"), nd)
+    if channels_last:
+        # keep the reference (Cin, Cout/g, *k) weight; relayout the data
+        # around the NCHW kernel path (XLA folds the moveaxes into its
+        # layout assignment)
+        data = jnp.moveaxis(data, -1, 1)
     g = p["num_group"]
     stride = _conv_tuple(p["stride"], nd)
     dilate = _conv_tuple(p["dilate"], nd)
@@ -171,6 +207,8 @@ def _deconvolution(p, c, data, weight, bias=None):
         out = out.astype(data.dtype)
     if bias is not None:
         out = out + bias.reshape((1, -1) + (1,) * nd)
+    if channels_last:
+        out = jnp.moveaxis(out, 1, -1)
     return out
 
 
@@ -179,17 +217,21 @@ def _deconv_infer_shape(p, in_shapes):
     if dshape is None or 0 in dshape:
         return None
     nd = len(p["kernel"])
+    channels_last = _channels_last(p.get("layout"), nd)
     stride = _conv_tuple(p["stride"], nd)
     pad = _conv_tuple(p["pad"], nd, 0)
     adj = _conv_tuple(p["adj"], nd, 0)
-    cin = dshape[1]
+    cin = dshape[-1] if channels_last else dshape[1]
+    in_sp = dshape[1:-1] if channels_last else dshape[2:]
     wshape = (cin, p["num_filter"] // p["num_group"]) + tuple(p["kernel"])
-    out_sp = tuple(stride[i] * (dshape[2 + i] - 1) + p["kernel"][i]
+    out_sp = tuple(stride[i] * (in_sp[i] - 1) + p["kernel"][i]
                    - 2 * pad[i] + adj[i] for i in range(nd))
     shapes = [tuple(dshape), wshape]
     if not p["no_bias"]:
         shapes.append((p["num_filter"],))
-    return shapes, [(dshape[0], p["num_filter"]) + out_sp], []
+    out = (dshape[0],) + out_sp + (p["num_filter"],) if channels_last \
+        else (dshape[0], p["num_filter"]) + out_sp
+    return shapes, [out], []
 
 
 # ----------------------------------------------------------------------
@@ -203,12 +245,16 @@ def _deconv_infer_shape(p, in_shapes):
                              enum=("valid", "full")),
                        Param("stride", "shape", None),
                        Param("pad", "shape", None),
+                       Param("layout", str, None),
                        Param("cudnn_off", bool, False)),
           hint="pooling")
 def _pooling(p, c, data):
     nd = data.ndim - 2
+    channels_last = _channels_last(p.get("layout"), nd)
+    sp0 = 1 if channels_last else 2           # first spatial dim index
+    spatial = data.shape[sp0:sp0 + nd]
     if p["global_pool"]:
-        kernel = data.shape[2:]
+        kernel = spatial
         stride = (1,) * nd
         pad = (0,) * nd
     else:
@@ -220,14 +266,19 @@ def _pooling(p, c, data):
         lo = pad[i]
         hi = pad[i]
         if p["pooling_convention"] == "full" and not p["global_pool"]:
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            size = spatial[i] + 2 * pad[i] - kernel[i]
             rem = size % stride[i]
             if rem != 0:
                 hi += stride[i] - rem  # ceil instead of floor
         lo_hi.append((lo, hi))
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    padding = ((0, 0), (0, 0)) + tuple(lo_hi)
+    if channels_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        padding = ((0, 0),) + tuple(lo_hi) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        padding = ((0, 0), (0, 0)) + tuple(lo_hi)
     if p["pool_type"] == "max":
         init = (np.array(-np.inf, data.dtype)
                 if jnp.issubdtype(data.dtype, jnp.floating)
@@ -250,19 +301,27 @@ def _pool_infer_shape(p, in_shapes):
     if dshape is None or 0 in dshape:
         return None
     nd = len(dshape) - 2
+    channels_last = _channels_last(p.get("layout"), nd)
+
+    def assemble(sp):
+        if channels_last:
+            return (dshape[0],) + tuple(sp) + (dshape[-1],)
+        return tuple(dshape[:2]) + tuple(sp)
+
+    spatial = dshape[1:-1] if channels_last else dshape[2:]
     if p["global_pool"]:
-        return [tuple(dshape)], [tuple(dshape[:2]) + (1,) * nd], []
+        return [tuple(dshape)], [assemble((1,) * nd)], []
     kernel = tuple(p["kernel"])
     stride = _conv_tuple(p["stride"], nd)
     pad = _conv_tuple(p["pad"], nd, 0)
     out_sp = []
     for i in range(nd):
-        size = dshape[2 + i] + 2 * pad[i] - kernel[i]
+        size = spatial[i] + 2 * pad[i] - kernel[i]
         if p["pooling_convention"] == "full":
             out_sp.append(int(np.ceil(size / stride[i])) + 1)
         else:
             out_sp.append(size // stride[i] + 1)
-    return [tuple(dshape)], [tuple(dshape[:2]) + tuple(out_sp)], []
+    return [tuple(dshape)], [assemble(out_sp)], []
 
 
 # ----------------------------------------------------------------------
@@ -390,8 +449,13 @@ def _batch_norm(p, c, data, gamma, beta, moving_mean, moving_var):
         gamma = lax.stop_gradient(jnp.ones_like(gamma))
     use_batch_stats = c.is_train and not p["use_global_stats"]
     if use_batch_stats:
-        mean = jnp.mean(data, axis=reduce_axes)
-        var = jnp.var(data, axis=reduce_axes)
+        # accumulate statistics in f32: a bf16 sum over N*H*W elements
+        # loses the mean entirely (8 mantissa bits); XLA fuses the
+        # widening cast into the reduction so HBM traffic is unchanged
+        stat_in = data.astype(jnp.float32) \
+            if data.dtype in (jnp.bfloat16, jnp.float16) else data
+        mean = jnp.mean(stat_in, axis=reduce_axes).astype(data.dtype)
+        var = jnp.var(stat_in, axis=reduce_axes).astype(data.dtype)
         m = p["momentum"]
         new_mean = moving_mean * m + lax.stop_gradient(mean) * (1 - m)
         new_var = moving_var * m + lax.stop_gradient(var) * (1 - m)

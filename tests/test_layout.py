@@ -1,0 +1,82 @@
+"""Channels-last (NHWC) layout support: Convolution/Pooling layout
+param, BatchNorm axis, and the resnet factory's layout option."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io, models
+
+
+def test_conv_nhwc_matches_nchw():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 8, 8).astype("f")          # NCHW
+    w = rng.randn(4, 3, 3, 3).astype("f")          # OIHW
+    b = rng.randn(4).astype("f")
+    out_nchw = mx.nd.Convolution(
+        mx.nd.array(x), mx.nd.array(w), mx.nd.array(b),
+        kernel=(3, 3), num_filter=4, pad=(1, 1), stride=(2, 2)).asnumpy()
+    # NHWC data + HWIO weight must give the transposed result
+    x_t = np.transpose(x, (0, 2, 3, 1))
+    w_t = np.transpose(w, (2, 3, 1, 0))
+    out_nhwc = mx.nd.Convolution(
+        mx.nd.array(x_t), mx.nd.array(w_t), mx.nd.array(b),
+        kernel=(3, 3), num_filter=4, pad=(1, 1), stride=(2, 2),
+        layout="NHWC").asnumpy()
+    np.testing.assert_allclose(np.transpose(out_nhwc, (0, 3, 1, 2)),
+                               out_nchw, rtol=1e-4, atol=1e-4)
+
+
+def test_pooling_nhwc_matches_nchw():
+    rng = np.random.RandomState(4)
+    x = rng.randn(2, 3, 8, 8).astype("f")
+    for pool_type in ("max", "avg"):
+        ref = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                            pool_type=pool_type).asnumpy()
+        got = mx.nd.Pooling(mx.nd.array(np.transpose(x, (0, 2, 3, 1))),
+                            kernel=(2, 2), stride=(2, 2),
+                            pool_type=pool_type, layout="NHWC").asnumpy()
+        np.testing.assert_allclose(np.transpose(got, (0, 3, 1, 2)), ref,
+                                   rtol=1e-5, atol=1e-5)
+    # global pool honors the layout's spatial dims
+    g = mx.nd.Pooling(mx.nd.array(np.transpose(x, (0, 2, 3, 1))),
+                      kernel=(2, 2), global_pool=True, pool_type="avg",
+                      layout="NHWC")
+    assert g.shape == (2, 1, 1, 3)
+
+
+def test_conv_nhwc_shape_inference():
+    d = mx.sym.Variable("data")
+    c = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           layout="NHWC", name="c")
+    args, outs, _ = c.infer_shape(data=(2, 16, 16, 4))
+    shapes = dict(zip(c.list_arguments(), args))
+    assert shapes["c_weight"] == (3, 3, 4, 8)      # HWIO
+    assert outs[0] == (2, 16, 16, 8)
+
+
+def test_resnet_nhwc_trains():
+    rng = np.random.RandomState(0)
+    n, k = 64, 4
+    x = rng.randn(n, 8, 8, 3).astype("f")
+    w = rng.randn(8 * 8 * 3, k).astype("f")
+    y = np.argmax(x.reshape(n, -1) @ w, axis=1).astype("f")
+    sym = models.resnet.get_symbol(num_classes=k, num_layers=8,
+                                   image_shape=(8, 8, 3), layout="NHWC")
+    train = io.NDArrayIter(x, y, batch_size=16, shuffle=False)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.fit(train, num_epoch=12, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.init.Xavier(factor_type="in", magnitude=2.0))
+    train.reset()
+    assert mod.score(train, "acc")[0][1] > 0.8
+
+
+def test_resnet_s2d_builds_and_infers():
+    sym = models.resnet.get_symbol(num_classes=10, num_layers=50,
+                                   image_shape=(224, 224, 3),
+                                   layout="NHWC", conv0_space_to_depth=True)
+    _, outs, _ = sym.infer_shape(data=(2, 224, 224, 3),
+                                 softmax_label=(2,))
+    assert outs[0] == (2, 10)
+    with pytest.raises(ValueError):
+        models.resnet.get_symbol(num_classes=10, conv0_space_to_depth=True)
