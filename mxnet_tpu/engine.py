@@ -153,6 +153,24 @@ def get() -> Engine:
     return _DEFAULT
 
 
+def _flush_at_exit():
+    """Drain pending engine ops (async checkpoint writes, prefetch) at
+    interpreter shutdown — the reference engine's shutdown WaitForAll.
+    Bounded: a wedged op (blocking data source) must not hang exit."""
+    if _DEFAULT is not None:
+        try:
+            waiter = threading.Thread(target=_DEFAULT.wait_all, daemon=True)
+            waiter.start()
+            waiter.join(timeout=10.0)
+        except Exception:
+            pass
+
+
+import atexit  # noqa: E402
+
+atexit.register(_flush_at_exit)
+
+
 def set_engine_type(engine_type: str):
     """Swap the global engine (must be called before first use)."""
     global _DEFAULT

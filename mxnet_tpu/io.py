@@ -170,9 +170,16 @@ class PrefetchingIter(DataIter):
     """Double-buffering prefetcher over one or more iterators
     (reference ``io.py:281-423``; C++ analog ``iter_prefetcher.h``).
 
-    Producer threads pull from the wrapped iterators while the consumer
-    (the training loop / TPU step) works on the previous batch, overlapping
-    host decode with device compute.
+    Producer work is scheduled through the native dependency engine
+    (``mxnet_tpu.engine`` over ``native/mxtpu_runtime.cc``): each wrapped
+    iterator owns an engine variable; producing its next batch is an
+    engine op that *writes* that variable, and the consumer waits on the
+    variable before taking the batch — the same read/write dependency
+    protocol the reference engine applies to its IO pipeline
+    (``iter_prefetcher.h`` over ``dmlc::ThreadedIter``).  Under
+    ``MXNET_ENGINE_TYPE=NaiveEngine`` production runs synchronously at
+    push time (the serial debugging mode, ``src/engine/engine.cc:13-39``);
+    the default threaded engine overlaps host decode with device compute.
     """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
@@ -186,38 +193,45 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0].shape[0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        from . import engine as _engine
+        self._engine = _engine.get()
+        self._vars = [self._engine.new_variable()
+                      for _ in range(self.n_iter)]
+        self.current_batch = [None] * self.n_iter
+        self.next_batch = [None] * self.n_iter
+        self._scheduled = [False] * self.n_iter
+        for i in range(self.n_iter):
+            self._schedule(i)
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
+    def _schedule(self, i):
+        """Push production of iterator ``i``'s next batch as an engine op
+        writing var ``i``."""
 
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
+        def produce():
+            try:
+                self.next_batch[i] = self.iters[i].next()
+            except StopIteration:
+                self.next_batch[i] = None
+
+        self._scheduled[i] = True
+        self._engine.push(produce, mutable_vars=[self._vars[i]])
+
+    def _drain(self):
+        """Wait out in-flight productions (before reset/teardown)."""
+        for i in range(self.n_iter):
+            if self._scheduled[i]:
+                self._engine.wait_for_var(self._vars[i])
+                self._scheduled[i] = False
 
     def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
-        for thread in self.prefetch_threads:
-            thread.join(timeout=1.0)
+        # bounded: a stuck producer (blocking source) must not hang GC —
+        # drain on a daemon thread with the old 1s-join patience
+        try:
+            t = threading.Thread(target=self._drain, daemon=True)
+            t.start()
+            t.join(timeout=1.0)
+        except Exception:
+            pass
 
     @property
     def provide_data(self):
@@ -238,21 +252,20 @@ class PrefetchingIter(DataIter):
                     for r, i in zip(self.rename_label, self.iters)], [])
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        self._drain()
+        for it in self.iters:
+            it.reset()
+        for i in range(self.n_iter):
+            self._schedule(i)
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
+        for i in range(self.n_iter):
+            if self._scheduled[i]:
+                self._engine.wait_for_var(self._vars[i])
+                self._scheduled[i] = False
         if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iterators"
+            for b in self.next_batch:
+                assert b is None, "Number of entry mismatches between iterators"
             return False
         for batch in self.next_batch:
             assert batch.pad == self.next_batch[0].pad, \
@@ -264,10 +277,8 @@ class PrefetchingIter(DataIter):
             self.next_batch[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        for i in range(self.n_iter):
+            self._schedule(i)
         return True
 
     def next(self):

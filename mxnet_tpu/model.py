@@ -89,14 +89,45 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
-def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+_ckpt_vars = {}
+
+
+def _checkpoint_var(prefix):
+    """One engine variable per checkpoint prefix: successive async writes
+    to the same prefix are WAW-ordered by the dependency engine."""
+    from . import engine as _engine
+    if prefix not in _ckpt_vars:
+        _ckpt_vars[prefix] = _engine.get().new_variable()
+    return _ckpt_vars[prefix]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    async_write=False):
     """Checkpoint to ``prefix-symbol.json`` + ``prefix-%04d.params``
-    (reference ``model.py:319-341``)."""
+    (reference ``model.py:319-341``).
+
+    ``async_write=True`` snapshots the parameter values synchronously
+    (device→host pull), then schedules the file IO on the dependency
+    engine so the training loop is not blocked on disk; call
+    ``engine.get().wait_all()`` (or exit) to be sure it landed."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
+    if async_write:
+        # pull values now (the checkpoint must capture this step's state,
+        # not whatever the weights hold when the disk write runs)
+        snapshot = {k: v.asnumpy() for k, v in save_dict.items()}
+
+        def write():
+            nd.save(param_name,
+                    {k: nd.array(v) for k, v in snapshot.items()})
+            logging.info("Saved checkpoint to \"%s\" (async)", param_name)
+
+        from . import engine as _engine
+        _engine.get().push(write, mutable_vars=[_checkpoint_var(prefix)])
+        return
     nd.save(param_name, save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
 
