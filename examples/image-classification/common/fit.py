@@ -32,6 +32,12 @@ def _get_lr_scheduler(args, kv):
 
 
 def _load_model(args, rank=0):
+    if getattr(args, "auto_resume", 0) and args.load_epoch is None \
+            and args.model_prefix:
+        found = mx.model.latest_checkpoint(args.model_prefix)
+        if found is not None:
+            args.load_epoch = found
+            logging.info("auto-resume: picking up at epoch %d", found)
     if args.load_epoch is None:
         return (None, None, None)
     assert args.model_prefix is not None
@@ -76,6 +82,10 @@ def add_fit_args(parser):
     train.add_argument("--disp-batches", type=int, default=20)
     train.add_argument("--model-prefix", type=str)
     train.add_argument("--load-epoch", type=int)
+    train.add_argument("--auto-resume", type=int, default=0,
+                       help="1 = resume from the newest checkpoint under "
+                       "--model-prefix if one exists (crash-restart "
+                       "recovery; pairs with launch.py --auto-restart)")
     train.add_argument("--top-k", type=int, default=0)
     train.add_argument("--dtype", type=str, default="float32",
                        help="bfloat16 enables mixed-precision training")

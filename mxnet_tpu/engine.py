@@ -111,13 +111,18 @@ class Engine:
                              ctypes.c_void_p(seq), carr, nc, marr, nm,
                              priority)
 
-    def wait_all(self):
+    def wait_all(self, reraise=True):
         _lib().MXTEngineWaitAll(self._handle)
-        self._raise_pending()
+        if reraise:
+            self._raise_pending()
 
-    def wait_for_var(self, var: Var):
+    def wait_for_var(self, var: Var, reraise=True):
+        """Block until every op writing/reading ``var`` completed.
+        ``reraise=False`` leaves any pending op failure in place for the
+        next real sync point (GC-time drains must not swallow it)."""
         _lib().MXTEngineWaitForVar(self._handle, var.handle)
-        self._raise_pending()
+        if reraise:
+            self._raise_pending()
 
     def _raise_pending(self):
         with self._ka_lock:

@@ -1,0 +1,90 @@
+"""Worker health: heartbeats + dead-node detection.
+
+The reference surfaces worker/server liveness through ps-lite heartbeats
+(``include/mxnet/kvstore.h:235-244`` ``get_num_dead_node``;
+``src/kvstore/kvstore_dist.h:157-166``) and restart-aware barriers
+(``is_recovery``, ``kvstore_dist.h:39-44``).  The TPU build has no server
+role and XLA collectives are fail-stop, so recovery = detect + restart +
+reload checkpoint (SURVEY §5).  This module provides the detection half:
+each worker's :class:`Heartbeat` thread stamps ``hb-<rank>`` in a shared
+directory (set by the launcher via ``MXTPU_HEARTBEAT_DIR``); any worker
+can ask which ranks have gone stale.  ``tools/launch.py --auto-restart``
+provides the restart half.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional
+
+__all__ = ["Heartbeat", "dead_nodes", "heartbeat_dir"]
+
+_DEFAULT_INTERVAL = 1.0
+
+
+def heartbeat_dir() -> Optional[str]:
+    return os.environ.get("MXTPU_HEARTBEAT_DIR") or None
+
+
+def _stamp_path(directory: str, rank: int) -> str:
+    return os.path.join(directory, "hb-%d" % rank)
+
+
+class Heartbeat:
+    """Background stamper for one worker's liveness file."""
+
+    def __init__(self, rank: int, directory: Optional[str] = None,
+                 interval: float = _DEFAULT_INTERVAL):
+        self.rank = rank
+        self.directory = directory or heartbeat_dir()
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = None
+        if self.directory:
+            os.makedirs(self.directory, exist_ok=True)
+            self._beat()
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    @property
+    def active(self) -> bool:
+        return self._thread is not None
+
+    def _beat(self):
+        path = _stamp_path(self.directory, self.rank)
+        with open(path, "w") as f:
+            f.write("%f\n" % time.time())
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                self._beat()
+            except OSError:
+                pass
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+            self._thread = None
+
+
+def dead_nodes(num_workers: int, timeout: float = 60.0,
+               directory: Optional[str] = None) -> List[int]:
+    """Ranks whose heartbeat is missing or older than ``timeout`` seconds
+    (the ``get_num_dead_node`` scan).  Empty when heartbeats are not
+    configured — matching the reference's single-process behavior."""
+    directory = directory or heartbeat_dir()
+    if not directory or not os.path.isdir(directory):
+        return []
+    now = time.time()
+    dead = []
+    for rank in range(num_workers):
+        path = _stamp_path(directory, rank)
+        try:
+            if now - os.path.getmtime(path) > timeout:
+                dead.append(rank)
+        except OSError:
+            dead.append(rank)
+    return dead

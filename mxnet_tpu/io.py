@@ -193,10 +193,15 @@ class PrefetchingIter(DataIter):
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0].shape[0]
-        from . import engine as _engine
-        self._engine = _engine.get()
+        try:
+            from . import engine as _engine
+            self._engine = _engine.get()
+        except RuntimeError:
+            # no native runtime on this host: degrade to synchronous
+            # production (the NaiveEngine behavior)
+            self._engine = None
         self._vars = [self._engine.new_variable()
-                      for _ in range(self.n_iter)]
+                      for _ in range(self.n_iter)] if self._engine else []
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
         self._scheduled = [False] * self.n_iter
@@ -213,21 +218,25 @@ class PrefetchingIter(DataIter):
             except StopIteration:
                 self.next_batch[i] = None
 
+        if self._engine is None:
+            produce()
+            return
         self._scheduled[i] = True
         self._engine.push(produce, mutable_vars=[self._vars[i]])
 
-    def _drain(self):
+    def _drain(self, reraise=True):
         """Wait out in-flight productions (before reset/teardown)."""
         for i in range(self.n_iter):
             if self._scheduled[i]:
-                self._engine.wait_for_var(self._vars[i])
+                self._engine.wait_for_var(self._vars[i], reraise=reraise)
                 self._scheduled[i] = False
 
     def __del__(self):
         # bounded: a stuck producer (blocking source) must not hang GC —
         # drain on a daemon thread with the old 1s-join patience
         try:
-            t = threading.Thread(target=self._drain, daemon=True)
+            t = threading.Thread(target=lambda: self._drain(reraise=False),
+                                 daemon=True)
             t.start()
             t.join(timeout=1.0)
         except Exception:

@@ -148,6 +148,12 @@ class KVStore(object):
     def num_workers(self):
         return 1
 
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Count of peers whose liveness has lapsed (reference
+        ``include/mxnet/kvstore.h:235-244`` ``get_num_dead_node``).
+        A single-process store has no peers to lose."""
+        return 0
+
     def save_optimizer_states(self, fname):
         if self._updater is None:
             raise MXNetError("Cannot save states for distributed training")
@@ -195,6 +201,10 @@ class KVStoreTPU(KVStore):
         super().__init__(kind)
         import jax
         self._jax = jax
+        # liveness stamping when the launcher configured a heartbeat dir
+        # (MXTPU_HEARTBEAT_DIR); no-op otherwise
+        from . import health as _health
+        self._heartbeat = _health.Heartbeat(self.rank)
 
     @property
     def rank(self):
@@ -235,6 +245,13 @@ class KVStoreTPU(KVStore):
             from .parallel.collectives import global_allreduce
             merged = NDArray(global_allreduce(merged.data))
         return merged
+
+    def num_dead_node(self, node_id=0, timeout=60):
+        """Ranks with lapsed heartbeats (reference ``get_num_dead_node``
+        over ps-lite heartbeats; here a shared-directory stamp scan set
+        up by the launcher)."""
+        from . import health as _health
+        return len(_health.dead_nodes(self.num_workers, timeout=timeout))
 
     def _barrier(self):
         if self.num_workers > 1:

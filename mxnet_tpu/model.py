@@ -89,6 +89,15 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(index * num_device + k, g, w)
 
 
+def _atomic_save(path, save_dict):
+    """Write-then-rename so a crash mid-write never leaves a truncated
+    checkpoint where auto-resume would pick it up."""
+    import os
+    tmp = path + ".tmp"
+    nd.save(tmp, save_dict)
+    os.replace(tmp, path)
+
+
 _ckpt_vars = {}
 
 
@@ -109,7 +118,8 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     ``async_write=True`` snapshots the parameter values synchronously
     (device→host pull), then schedules the file IO on the dependency
     engine so the training loop is not blocked on disk; call
-    ``engine.get().wait_all()`` (or exit) to be sure it landed."""
+    ``engine.get().wait_all()`` to be sure it landed (process exit
+    flushes pending writes with a bounded ~10s grace)."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
@@ -121,15 +131,29 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
         snapshot = {k: v.asnumpy() for k, v in save_dict.items()}
 
         def write():
-            nd.save(param_name,
-                    {k: nd.array(v) for k, v in snapshot.items()})
+            _atomic_save(param_name,
+                         {k: nd.array(v) for k, v in snapshot.items()})
             logging.info("Saved checkpoint to \"%s\" (async)", param_name)
 
         from . import engine as _engine
         _engine.get().push(write, mutable_vars=[_checkpoint_var(prefix)])
         return
-    nd.save(param_name, save_dict)
+    _atomic_save(param_name, save_dict)
     logging.info("Saved checkpoint to \"%s\"", param_name)
+
+
+def latest_checkpoint(prefix):
+    """Newest saved epoch for ``prefix`` (``prefix-NNNN.params``), or
+    None — the auto-resume scan."""
+    import glob
+    import re
+    newest = None
+    for path in glob.glob(glob.escape(prefix) +
+                          "-[0-9][0-9][0-9][0-9].params"):
+        m = re.search(r"-(\d{4})\.params$", path)
+        if m:
+            newest = max(newest or 0, int(m.group(1)))
+    return newest
 
 
 def load_checkpoint(prefix, epoch):

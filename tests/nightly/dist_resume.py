@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Failure-detection + crash-restart recovery test.
+
+Maps the reference's elastic story (ps-lite heartbeats →
+``get_num_dead_node``; restart-aware barriers → ``is_recovery``,
+``src/kvstore/kvstore_dist.h:39-44,157-166``) onto the TPU design of
+SURVEY §5: collectives are fail-stop, so recovery = detect the dead
+rank, restart the job, reload the last checkpoint.
+
+Run under the launcher's restart orchestration:
+
+    python tools/launch.py -n 2 --launcher local --auto-restart 1 -- \
+        python tests/nightly/dist_resume.py <workdir>
+
+First attempt: rank 1 crashes after epoch 2 (simulated worker death);
+rank 0 observes the lapsed heartbeat via ``kv.num_dead_node`` before the
+launcher tears the job down and relaunches.  Second attempt: every rank
+auto-resumes from the newest shared checkpoint and trains to completion.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+CRASH_AFTER_EPOCH = 1
+TOTAL_EPOCHS = 8
+
+
+def main():
+    import mxnet_tpu as mx
+
+    workdir = sys.argv[1]
+    prefix = os.path.join(workdir, "ckpt")
+    marker = os.path.join(workdir, "crashed-once")
+
+    kv = mx.kv.create("dist_sync_tpu")
+    rank, nworker = kv.rank, kv.num_workers
+    kv._barrier()          # both kvstores exist => both heartbeats stamped
+    assert kv.num_dead_node(timeout=30) == 0, "all ranks should be alive"
+
+    rng = np.random.RandomState(11)
+    n = 512
+    X = rng.normal(0, 1, (n, 16)).astype("f")
+    Y = (X @ rng.normal(0, 1, (16, 4))).argmax(1).astype("f")
+    Xs, Ys = X[rank::nworker], Y[rank::nworker]
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+
+    begin = 0
+    arg_params = aux_params = None
+    resumed = mx.model.latest_checkpoint(prefix)
+    if resumed is not None:
+        _, arg_params, aux_params = mx.model.load_checkpoint(prefix, resumed)
+        begin = resumed
+        print("worker %d: auto-resume from epoch %d" % (rank, begin),
+              flush=True)
+
+    first_attempt = not os.path.exists(marker)
+
+    def epoch_cb(epoch, sym, arg, aux):
+        if rank == 0:
+            mx.model.save_checkpoint(prefix, epoch + 1, sym, arg, aux)
+        if first_attempt and rank == 1 and epoch >= CRASH_AFTER_EPOCH:
+            open(marker, "w").write("1")
+            print("worker 1: simulating crash after epoch %d" % epoch,
+                  flush=True)
+            os._exit(3)
+
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(net)
+    try:
+        mod.fit(it, num_epoch=TOTAL_EPOCHS, begin_epoch=begin, kvstore=kv,
+                arg_params=arg_params, aux_params=aux_params,
+                allow_missing=False,
+                optimizer="sgd", optimizer_params={"learning_rate": 0.25},
+                initializer=mx.init.Xavier(rnd_type="gaussian",
+                                           magnitude=2.0),
+                epoch_end_callback=epoch_cb)
+    except Exception as e:                       # noqa: BLE001
+        # a collective failed: attribute the failure with the health
+        # surface (the reference diagnoses via get_num_dead_node) and
+        # exit nonzero so the launcher's restart orchestration kicks in
+        time.sleep(2.5)                 # let the peer's heartbeat lapse
+        dead = kv.num_dead_node(timeout=2)
+        print("worker %d: collective failed; detected %d dead rank(s) "
+              "via num_dead_node (%s)" % (rank, dead, type(e).__name__),
+              flush=True)
+        os._exit(4)
+
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, "worker %d accuracy %.3f" % (rank, acc)
+    kv._barrier()
+    print("worker %d/%d: recovery train done acc=%.3f (resumed from %s)"
+          % (rank, nworker, acc, resumed), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,3 +49,27 @@ def test_cpu_tpu_consistency():
                   res.stdout)
     assert (m and int(m.group(1)) > 30 and m.group(2) == "0") \
         or "SKIP" in res.stdout, res.stdout
+
+
+def test_failure_detection_and_restart(tmp_path):
+    """Kill 1 of 2 workers mid-training: the survivor must attribute the
+    failure via num_dead_node, the launcher must restart, and the job
+    must resume from the checkpoint and converge (VERDICT/SURVEY §5
+    failure-recovery contract)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--auto-restart", "1",
+         "--detect-grace", "6", "--",
+         sys.executable,
+         os.path.join(_ROOT, "tests", "nightly", "dist_resume.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, timeout=560, env=env, cwd=_ROOT)
+    out = res.stdout + res.stderr
+    assert res.returncode == 0, out
+    assert "simulating crash" in out, out
+    assert "detected 1 dead rank(s) via num_dead_node" in out, out
+    assert "restart 1/1" in out, out
+    assert "auto-resume from epoch" in out, out
+    assert out.count("recovery train done") == 2, out

@@ -1,0 +1,34 @@
+"""Heartbeat liveness surface (reference get_num_dead_node,
+``include/mxnet/kvstore.h:235-244``)."""
+import time
+
+import mxnet_tpu as mx
+from mxnet_tpu import health
+
+
+def test_heartbeat_detection(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    h0 = health.Heartbeat(0, interval=0.05)
+    h1 = health.Heartbeat(1, interval=0.05)
+    assert h0.active and h1.active
+    time.sleep(0.15)
+    assert health.dead_nodes(2, timeout=1.0) == []
+    h1.stop()                         # rank 1 "dies"
+    time.sleep(0.5)
+    assert health.dead_nodes(2, timeout=0.3) == [1]
+    # a never-started rank counts as dead too
+    assert health.dead_nodes(3, timeout=0.3) == [1, 2]
+    h0.stop()
+
+
+def test_heartbeat_noop_without_dir(monkeypatch):
+    monkeypatch.delenv("MXTPU_HEARTBEAT_DIR", raising=False)
+    h = health.Heartbeat(0)
+    assert not h.active
+    assert health.dead_nodes(4, timeout=0.1) == []
+    h.stop()
+
+
+def test_kvstore_num_dead_node_local():
+    kv = mx.kv.create("local")
+    assert kv.num_dead_node() == 0

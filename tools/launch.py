@@ -43,9 +43,14 @@ def _free_port():
     return port
 
 
-def launch_local(args):
+def _run_local_once(args, allow_grace):
+    """One attempt: fork N workers, tear the job down if any crashes."""
+    import shutil
+    import tempfile
+    import time as _time
     port = _free_port()
     coordinator = "127.0.0.1:%d" % port
+    hb_dir = tempfile.mkdtemp(prefix="mxtpu-hb-")
     procs = []
     for rank in range(args.num_workers):
         env = dict(os.environ)
@@ -60,6 +65,8 @@ def launch_local(args):
             # local mode runs on host CPU devices
             "JAX_PLATFORMS": "cpu",
             "TPU_SKIP_MDS_QUERY": "1",
+            # liveness stamps for KVStore.num_dead_node
+            "MXTPU_HEARTBEAT_DIR": hb_dir,
         })
         if args.devices_per_worker:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
@@ -77,18 +84,46 @@ def launch_local(args):
     signal.signal(signal.SIGTERM, _kill_all)
     # poll all workers: one crashing must tear the job down immediately
     # (survivors block in jax.distributed.initialize waiting for peers)
-    import time as _time
     live = list(procs)
-    while live:
-        for p in list(live):
-            rc = p.poll()
-            if rc is None:
-                continue
-            live.remove(p)
-            if rc != 0:
-                code = code or rc
-                _kill_all()
-        _time.sleep(0.1)
+    graced = False
+    try:
+        while live:
+            for p in list(live):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                live.remove(p)
+                if rc != 0:
+                    code = code or rc
+                    if allow_grace and not graced:
+                        # grace window before teardown so survivors can
+                        # observe the lapsed heartbeat (num_dead_node)
+                        # and log the detection; they are parked in
+                        # collectives anyway
+                        graced = True
+                        _time.sleep(args.detect_grace)
+                    _kill_all()
+            _time.sleep(0.1)
+    finally:
+        shutil.rmtree(hb_dir, ignore_errors=True)
+    return code
+
+
+def launch_local(args):
+    """Local launcher with crash-restart orchestration: a failed attempt
+    (a worker died) is relaunched up to ``--auto-restart`` times; workers
+    resume from their checkpoints (``--load-epoch`` / auto-resume) — the
+    TPU mapping of the reference's restart-aware recovery
+    (``kvstore_dist.h:39-44`` ``is_recovery``; SURVEY §5: ICI failures
+    are fail-stop, recovery = reload from checkpoint)."""
+    attempts = args.auto_restart + 1
+    for attempt in range(attempts):
+        code = _run_local_once(args, allow_grace=attempt + 1 < attempts)
+        if code == 0:
+            return 0
+        if attempt + 1 < attempts:
+            print("launch.py: job failed (rc=%d); restart %d/%d" %
+                  (code, attempt + 1, args.auto_restart), flush=True)
     return code
 
 
@@ -131,6 +166,14 @@ def main():
                         default="local")
     parser.add_argument("--devices-per-worker", type=int, default=0,
                         help="local mode: virtual CPU devices per process")
+    parser.add_argument("--auto-restart", type=int, default=0,
+                        help="local mode: relaunch the job up to N times "
+                        "after a worker crash (workers resume from their "
+                        "checkpoints)")
+    parser.add_argument("--detect-grace", type=float, default=5.0,
+                        help="auto-restart mode: seconds between a worker "
+                        "crash and job teardown, letting survivors log "
+                        "num_dead_node detection")
     parser.add_argument("-H", "--host-file", default=None,
                         help="ssh mode: one host per line")
     parser.add_argument("--port", type=int, default=9000,
