@@ -1,0 +1,149 @@
+// C++ core wrapper over the mxtpu C ABI (native/mxtpu_c_core.cc):
+// RAII NDArray, exceptions on error, and the imperative Invoke used by
+// the generated per-op wrappers in mxtpu_ops.hpp (produced from the op
+// registry by tools/gen_cpp_wrappers.py — the analog of the reference's
+// cpp-package OpWrapperGenerator.py pipeline).
+//
+// Link: -lmxtpu_c_api; the library embeds the Python/XLA runtime, so
+// run with PYTHONPATH pointing at the framework checkout.
+#ifndef MXTPU_CPP_HPP_
+#define MXTPU_CPP_HPP_
+
+#include <cstddef>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+extern "C" {
+const char *MXGetLastError();
+int MXNDArrayCreate(const unsigned *shape, unsigned ndim, int dev_type,
+                    int dev_id, int delay_alloc, void **out);
+int MXNDArraySyncCopyFromCPU(void *handle, const void *data, size_t size);
+int MXNDArraySyncCopyToCPU(void *handle, void *data, size_t size);
+int MXNDArrayGetShape(void *handle, unsigned *out_dim,
+                      const unsigned **out_pdata);
+int MXNDArrayFree(void *handle);
+int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                             void **inputs, int *num_outputs,
+                             void ***outputs, int num_params,
+                             const char **keys, const char **vals);
+}
+
+namespace mxtpu {
+
+inline void Check(int rc, const char *what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " + MXGetLastError());
+  }
+}
+
+// tuple-style shape parameter, serialized "(a, b, c)" like the
+// reference's dmlc::Parameter shape parsing expects
+struct Shape {
+  std::vector<int> dims;
+  Shape() = default;
+  Shape(std::initializer_list<int> d) : dims(d) {}
+  std::string str() const {
+    std::ostringstream os;
+    os << "(";
+    for (size_t i = 0; i < dims.size(); ++i)
+      os << (i ? ", " : "") << dims[i];
+    os << ")";
+    return os.str();
+  }
+};
+
+using KWArgs = std::map<std::string, std::string>;
+
+// round-trippable double -> string (std::to_string fixes 6 decimals and
+// zeroes small magnitudes)
+inline std::string FloatStr(double v) {
+  std::ostringstream os;
+  os.precision(17);
+  os << v;
+  return os.str();
+}
+
+class NDArray {
+ public:
+  NDArray() = default;
+  explicit NDArray(void *handle) : handle_(handle, Deleter) {}
+
+  NDArray(const std::vector<unsigned> &shape, const float *data = nullptr,
+          int dev_type = 6, int dev_id = 0) {
+    void *h = nullptr;
+    Check(MXNDArrayCreate(shape.data(),
+                          static_cast<unsigned>(shape.size()), dev_type,
+                          dev_id, 0, &h),
+          "MXNDArrayCreate");
+    handle_ = std::shared_ptr<void>(h, Deleter);
+    if (data != nullptr) CopyFrom(data);
+  }
+
+  void *handle() const { return handle_.get(); }
+
+  std::vector<unsigned> GetShape() const {
+    unsigned ndim = 0;
+    const unsigned *dims = nullptr;
+    Check(MXNDArrayGetShape(handle_.get(), &ndim, &dims),
+          "MXNDArrayGetShape");
+    return std::vector<unsigned>(dims, dims + ndim);
+  }
+
+  size_t Size() const {
+    size_t n = 1;
+    for (unsigned d : GetShape()) n *= d;
+    return n;
+  }
+
+  void CopyFrom(const float *data) {
+    Check(MXNDArraySyncCopyFromCPU(handle_.get(), data, Size()),
+          "MXNDArraySyncCopyFromCPU");
+  }
+
+  std::vector<float> ToVector() const {
+    std::vector<float> out(Size());
+    Check(MXNDArraySyncCopyToCPU(handle_.get(), out.data(), out.size()),
+          "MXNDArraySyncCopyToCPU");
+    return out;
+  }
+
+ private:
+  static void Deleter(void *h) {
+    if (h != nullptr) MXNDArrayFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
+
+// Invoke any registered operator imperatively (the choke point every
+// generated wrapper routes through).
+inline std::vector<NDArray> Invoke(const std::string &op,
+                                   const std::vector<NDArray> &inputs,
+                                   const KWArgs &kwargs = {}) {
+  std::vector<void *> in;
+  in.reserve(inputs.size());
+  for (const auto &a : inputs) in.push_back(a.handle());
+  std::vector<const char *> keys, vals;
+  for (const auto &kv : kwargs) {
+    keys.push_back(kv.first.c_str());
+    vals.push_back(kv.second.c_str());
+  }
+  int n_out = 0;
+  void **outs = nullptr;
+  Check(MXImperativeInvokeByName(
+            op.c_str(), static_cast<int>(in.size()), in.data(), &n_out,
+            &outs, static_cast<int>(keys.size()), keys.data(),
+            vals.data()),
+        op.c_str());
+  std::vector<NDArray> result;
+  result.reserve(n_out);
+  for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
+  return result;
+}
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_CPP_HPP_

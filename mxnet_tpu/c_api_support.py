@@ -1,0 +1,218 @@
+"""Python side of the flat C ABI core.
+
+The reference's C API (``include/mxnet/c_api.h``, ``src/c_api/*.cc``) is
+the single choke point between native code and every language binding.
+Here the execution substrate *is* Python/JAX, so the C shim
+(``native/mxtpu_c_api.cc``) stays a thin marshaling layer: every MX*
+entry point calls one plain function in this module with C-friendly
+types (str/bytes/tuples/lists) and gets back Python objects whose
+``PyObject*`` become the opaque ABI handles (NDArrayHandle,
+SymbolHandle, ExecutorHandle, KVStoreHandle).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import kvstore as _kvstore
+from . import ndarray as nd
+from . import symbol as sym
+from .base import Context
+
+
+def _ctx(dev_type, dev_id):
+    names = {1: "cpu", 2: "gpu", 3: "cpu", 6: "tpu"}
+    return Context(names.get(int(dev_type), "tpu"), int(dev_id))
+
+
+# ----------------------------------------------------------------------
+# NDArray
+def nd_create(shape, dev_type, dev_id):
+    return nd.zeros(tuple(int(d) for d in shape), ctx=_ctx(dev_type, dev_id))
+
+
+def nd_from_bytes(blob, shape, dev_type, dev_id):
+    arr = np.frombuffer(blob, dtype=np.float32).reshape(
+        tuple(int(d) for d in shape))
+    return nd.array(arr, ctx=_ctx(dev_type, dev_id))
+
+
+def nd_copy_from(handle, blob):
+    arr = np.frombuffer(blob, dtype=np.float32).reshape(handle.shape)
+    handle._set_data(nd.array(arr).data.astype(handle.dtype))
+    return True
+
+
+def nd_to_bytes(handle):
+    return np.ascontiguousarray(
+        handle.asnumpy().astype(np.float32)).tobytes()
+
+
+def nd_shape(handle):
+    return tuple(int(d) for d in handle.shape)
+
+
+def nd_save(fname, handles, names):
+    if names:
+        nd.save(fname, dict(zip(names, handles)))
+    else:
+        nd.save(fname, list(handles))
+
+
+def nd_load(fname):
+    loaded = nd.load(fname)
+    if isinstance(loaded, dict):
+        names = list(loaded.keys())
+        return [loaded[n] for n in names], names
+    return list(loaded), []
+
+
+def nd_wait_all():
+    from . import engine
+    try:
+        eng = engine.get()
+    except RuntimeError:
+        return True          # no native runtime: nothing to wait on
+    eng.wait_all()           # op failures must propagate to the ABI
+    return True
+
+
+# ----------------------------------------------------------------------
+# operators (imperative) — powers MXImperativeInvokeByName and the
+# generated cpp-package wrappers
+def op_names():
+    from .op import registry
+    return registry.list_ops()
+
+
+def op_invoke(name, inputs, keys, vals):
+    from .op import invoke as _invoke
+    from .op import registry
+    op = registry.get(name)
+    params = dict(zip(keys, vals))
+    outs = _invoke.invoke(op, list(inputs), params)
+    return list(outs)
+
+
+# ----------------------------------------------------------------------
+# Symbol
+def sym_variable(name):
+    return sym.Variable(name)
+
+
+def sym_create(op_name, param_keys, param_vals, name):
+    """Create an un-composed atomic symbol (reference
+    ``MXSymbolCreateAtomicSymbol``): inputs attach later via compose."""
+    fn = getattr(sym, op_name)
+    kwargs = dict(zip(param_keys, param_vals))
+    if name:
+        kwargs["name"] = name
+    return _DeferredAtomic(fn, kwargs)
+
+
+class _DeferredAtomic:
+    """Reference atomic symbols are composed with inputs after creation
+    (``MXSymbolCompose``); our symbol functions take inputs at call time,
+    so the atomic holds the call until compose."""
+
+    def __init__(self, fn, kwargs):
+        self.fn = fn
+        self.kwargs = kwargs
+
+
+def sym_compose(atomic, name, arg_names, args):
+    kwargs = dict(atomic.kwargs)
+    if name:
+        kwargs["name"] = name
+    if arg_names:
+        for k, v in zip(arg_names, args):
+            kwargs[k] = v
+        return atomic.fn(**kwargs)
+    return atomic.fn(*args, **kwargs)
+
+
+def sym_from_json(json_str):
+    return sym.load_json(json_str)
+
+
+def sym_to_json(symbol):
+    return symbol.tojson()
+
+
+def sym_list_arguments(symbol):
+    return list(symbol.list_arguments())
+
+
+def sym_list_outputs(symbol):
+    return list(symbol.list_outputs())
+
+
+def sym_list_aux(symbol):
+    return list(symbol.list_auxiliary_states())
+
+
+# ----------------------------------------------------------------------
+# Executor
+def executor_simple_bind(symbol, dev_type, dev_id, names, shapes,
+                         grad_req):
+    kwargs = {n: tuple(int(d) for d in s) for n, s in zip(names, shapes)}
+    return symbol.simple_bind(_ctx(dev_type, dev_id), grad_req=grad_req,
+                              **kwargs)
+
+
+def executor_arg(executor, name):
+    return executor.arg_dict[name]
+
+
+def executor_grad(executor, name):
+    return executor.grad_dict[name]
+
+
+def executor_aux(executor, name):
+    return executor.aux_dict[name]
+
+
+def executor_forward(executor, is_train):
+    executor.forward(is_train=bool(is_train))
+    return True
+
+
+def executor_backward(executor, out_grads):
+    executor.backward(list(out_grads) if out_grads else None)
+    return True
+
+
+def executor_outputs(executor):
+    return list(executor.outputs)
+
+
+# ----------------------------------------------------------------------
+# KVStore
+def kv_create(kind):
+    return _kvstore.create(kind)
+
+
+def kv_init(kv, key, value):
+    kv.init(int(key), value)
+    return True
+
+
+def kv_push(kv, key, value, priority):
+    kv.push(int(key), value, priority=int(priority))
+    return True
+
+
+def kv_pull(kv, key, out, priority):
+    kv.pull(int(key), out=out, priority=int(priority))
+    return True
+
+
+def kv_rank(kv):
+    return int(kv.rank)
+
+
+def kv_size(kv):
+    return int(kv.num_workers)
+
+
+def kv_type(kv):
+    return kv.type

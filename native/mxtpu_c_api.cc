@@ -28,40 +28,24 @@
 #include <string>
 #include <vector>
 
+#include "mxtpu_py.h"
+
+// definition of the ABI-wide thread-local error buffer (mxtpu_py.h)
+thread_local std::string mxtpu_last_error;
+
 typedef unsigned int mx_uint;
 typedef float mx_float;
 typedef void *PredictorHandle;
 
 namespace {
 
-thread_local std::string g_last_error;
-
 struct PredictorRec {
   PyObject *obj;                       // mxnet_tpu.predictor.Predictor
   std::vector<std::vector<mx_uint>> out_shapes;  // filled lazily
 };
 
-// Interpreter bootstrap: if the host program is not Python, start one.
-void EnsurePython() {
-  static std::once_flag once;
-  std::call_once(once, []() {
-    if (!Py_IsInitialized()) {
-      Py_InitializeEx(0);
-      // release the GIL acquired by initialization so that
-      // PyGILState_Ensure below works from any thread
-      PyEval_SaveThread();
-    }
-  });
-}
-
-class Gil {
- public:
-  Gil() { state_ = PyGILState_Ensure(); }
-  ~Gil() { PyGILState_Release(state_); }
-
- private:
-  PyGILState_STATE state_;
-};
+using Gil = MXTPUGil;
+constexpr auto EnsurePython = MXTPUEnsurePython;
 
 int Fail(const char *where) {
   Gil gil;
@@ -87,7 +71,7 @@ int Fail(const char *where) {
     Py_XDECREF(value);
     Py_XDECREF(tb);
   }
-  g_last_error = msg;
+  mxtpu_last_error = msg;
   return -1;
 }
 
@@ -95,7 +79,7 @@ int Fail(const char *where) {
 
 extern "C" {
 
-const char *MXGetLastError() { return g_last_error.c_str(); }
+const char *MXGetLastError() { return mxtpu_last_error.c_str(); }
 
 int MXGetVersion(int *out) {
   // MAJOR*10000 + MINOR*100 + PATCH, reference c_api.h MXGetVersion
@@ -107,7 +91,7 @@ int MXGetVersion(int *out) {
 // src/initialize.cc): drops the last-error buffer; the XLA runtime and
 // host engine clean up via normal teardown.
 int MXNotifyShutdown() {
-  g_last_error.clear();
+  mxtpu_last_error.clear();
   return 0;
 }
 
@@ -222,7 +206,7 @@ int MXPredSetInput(PredictorHandle handle, const char *key,
   PyObject *shape = PyDict_GetItemString(shapes, key);  // borrowed
   if (shape == nullptr) {
     Py_DECREF(shapes);
-    g_last_error = std::string("unknown input ") + key;
+    mxtpu_last_error = std::string("unknown input ") + key;
     return -1;
   }
   PyObject *np = PyImport_ImportModule("numpy");
@@ -273,7 +257,7 @@ int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
   PyBytes_AsStringAndSize(bytes, &buf, &len);
   if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
     Py_DECREF(bytes);
-    g_last_error = "MXPredGetOutput: size mismatch";
+    mxtpu_last_error = "MXPredGetOutput: size mismatch";
     return -1;
   }
   std::memcpy(data, buf, len);

@@ -1,0 +1,568 @@
+// mxtpu C ABI core: NDArray / operator / Symbol / Executor / KVStore.
+//
+// The reference exposes 119 MXNET_DLL functions (include/mxnet/c_api.h);
+// this file provides the load-bearing core of that choke point so
+// non-Python bindings can build symbols, bind executors, run forward/
+// backward, push/pull through a KVStore, and invoke any registered
+// operator imperatively (MXImperativeInvokeByName — what the generated
+// cpp-package wrappers call).  Handles are PyObject* of the underlying
+// mxnet_tpu objects; marshaling lives in mxnet_tpu/c_api_support.py.
+//
+// Reference signatures mirrored (c_api.h): MXNDArrayCreate (:219),
+// MXNDArraySyncCopyFromCPU/ToCPU (:307-322), MXNDArrayGetShape (:380),
+// MXNDArraySave/Load (:272-285), MXSymbolListAtomicSymbolCreators
+// (:557), MXSymbolCreateAtomicSymbol (:614), MXSymbolCreateVariable
+// (:623), MXSymbolCompose (:846), MXSymbolCreateFromJSON (:640),
+// MXSymbolSaveToJSON (:663), MXSymbolListArguments/Outputs/
+// AuxiliaryStates (:724-760), MXExecutorForward/Backward/Outputs
+// (:1012-1045), MXKVStoreCreate/Init/Push/Pull (:1202-1259).
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "mxtpu_py.h"
+
+typedef unsigned int mx_uint;
+typedef float mx_float;
+typedef void *NDArrayHandle;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *KVStoreHandle;
+typedef void *AtomicSymbolCreator;
+
+namespace {
+
+// Run support fn with printf-style args; on success store the new
+// reference in *out (may be nullptr-out for calls used only for effect).
+int Call(const char *fn, PyObject **out, const char *fmt, ...) {
+  MXTPUGil gil;
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject *args = Py_VaBuildValue(fmt, ap);
+  va_end(ap);
+  if (args == nullptr) return MXTPUFail(fn);
+  if (!PyTuple_Check(args)) {
+    PyObject *tup = PyTuple_Pack(1, args);
+    Py_DECREF(args);
+    args = tup;
+    if (args == nullptr) return MXTPUFail(fn);
+  }
+  PyObject *ret = MXTPUSupportCall(fn, args);
+  Py_DECREF(args);
+  if (ret == nullptr) return MXTPUFail(fn);
+  if (out != nullptr) {
+    *out = ret;
+  } else {
+    Py_DECREF(ret);
+  }
+  return 0;
+}
+
+PyObject *ShapeTuple(const mx_uint *shape, mx_uint ndim) {
+  PyObject *tup = PyTuple_New(ndim);
+  for (mx_uint i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(tup, i, PyLong_FromUnsignedLong(shape[i]));
+  return tup;
+}
+
+// per-thread string-list return store (the reference's
+// MXAPIThreadLocalEntry pattern)
+thread_local std::vector<std::string> tl_strings;
+thread_local std::vector<const char *> tl_ptrs;
+thread_local std::vector<mx_uint> tl_shape;
+thread_local std::vector<void *> tl_handles;
+thread_local std::string tl_json;
+
+int StringList(PyObject *list, mx_uint *out_size, const char ***out_array) {
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) return MXTPUFail("expected a string list");
+  tl_strings.clear();
+  tl_ptrs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PySequence_GetItem(list, i);
+    const char *s = item != nullptr ? PyUnicode_AsUTF8(item) : nullptr;
+    if (s == nullptr) {
+      Py_XDECREF(item);
+      return MXTPUFail("non-string entry");
+    }
+    tl_strings.emplace_back(s);
+    Py_DECREF(item);
+  }
+  for (const auto &s : tl_strings) tl_ptrs.push_back(s.c_str());
+  *out_size = static_cast<mx_uint>(tl_ptrs.size());
+  *out_array = tl_ptrs.data();
+  return 0;
+}
+
+int HandleList(PyObject *list, mx_uint *out_size, void ***out_array) {
+  // returned objects become caller-owned handles (freed via *Free)
+  Py_ssize_t n = PySequence_Size(list);
+  if (n < 0) return MXTPUFail("expected an object list");
+  tl_handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *item = PySequence_GetItem(list, i);  // new ref -> handle
+    if (item == nullptr) return MXTPUFail("bad list entry");
+    tl_handles.push_back(item);
+  }
+  *out_size = static_cast<mx_uint>(tl_handles.size());
+  *out_array = tl_handles.data();
+  return 0;
+}
+
+PyObject *StrTuple(mx_uint n, const char **strs) {
+  PyObject *tup = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i)
+    PyTuple_SET_ITEM(tup, i, PyUnicode_FromString(strs[i]));
+  return tup;
+}
+
+PyObject *ResolveMaybeComposed(PyObject *obj) {
+  // a composed atomic (MXSymbolCompose) carries the real Symbol in
+  // .composed — unwrap wherever a handle is consumed as a Symbol
+  if (PyObject_HasAttrString(obj, "composed")) {
+    return PyObject_GetAttrString(obj, "composed");  // new ref
+  }
+  Py_INCREF(obj);
+  return obj;
+}
+
+PyObject *ObjTuple(mx_uint n, void *const *handles) {
+  PyObject *tup = PyTuple_New(n);
+  for (mx_uint i = 0; i < n; ++i) {
+    PyObject *o = static_cast<PyObject *>(handles[i]);
+    Py_INCREF(o);
+    PyTuple_SET_ITEM(tup, i, o);
+  }
+  return tup;
+}
+
+int FreeHandle(void *handle) {
+  if (handle != nullptr) {
+    MXTPUGil gil;
+    Py_DECREF(static_cast<PyObject *>(handle));
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ----------------------------------------------------------------- NDArray
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  (void)delay_alloc;
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *tup = ShapeTuple(shape, ndim);
+  PyObject *ret = nullptr;
+  PyObject *args = Py_BuildValue("(Oii)", tup, dev_type, dev_id);
+  Py_DECREF(tup);
+  if (args == nullptr) return MXTPUFail("MXNDArrayCreate");
+  ret = MXTPUSupportCall("nd_create", args);
+  Py_DECREF(args);
+  if (ret == nullptr) return MXTPUFail("MXNDArrayCreate");
+  *out = ret;
+  return 0;
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  MXTPUGil gil;
+  PyObject *blob = PyBytes_FromStringAndSize(
+      static_cast<const char *>(data), size * sizeof(mx_float));
+  if (blob == nullptr) return MXTPUFail("MXNDArraySyncCopyFromCPU");
+  PyObject *args = Py_BuildValue("(ON)", handle, blob);
+  if (args == nullptr) return MXTPUFail("MXNDArraySyncCopyFromCPU");
+  PyObject *ret = MXTPUSupportCall("nd_copy_from", args);
+  Py_DECREF(args);
+  if (ret == nullptr) return MXTPUFail("MXNDArraySyncCopyFromCPU");
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  MXTPUGil gil;
+  PyObject *bytes = nullptr;
+  if (Call("nd_to_bytes", &bytes, "(O)", handle) != 0) return -1;
+  char *buf = nullptr;
+  Py_ssize_t len = 0;
+  PyBytes_AsStringAndSize(bytes, &buf, &len);
+  if (static_cast<size_t>(len) != size * sizeof(mx_float)) {
+    Py_DECREF(bytes);
+    mxtpu_last_error = "MXNDArraySyncCopyToCPU: size mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, len);
+  Py_DECREF(bytes);
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  MXTPUGil gil;
+  PyObject *shape = nullptr;
+  if (Call("nd_shape", &shape, "(O)", handle) != 0) return -1;
+  Py_ssize_t n = PySequence_Size(shape);
+  tl_shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *d = PySequence_GetItem(shape, i);
+    tl_shape.push_back(static_cast<mx_uint>(PyLong_AsUnsignedLong(d)));
+    Py_DECREF(d);
+  }
+  Py_DECREF(shape);
+  *out_dim = static_cast<mx_uint>(tl_shape.size());
+  *out_pdata = tl_shape.data();
+  return 0;
+}
+
+int MXNDArrayWaitAll() {
+  MXTPUEnsurePython();
+  return Call("nd_wait_all", nullptr, "()");
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  (void)handle;  // XLA async dispatch: reads synchronize on fetch
+  return 0;
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args,
+                  NDArrayHandle *args, const char **keys) {
+  MXTPUGil gil;
+  PyObject *handles = ObjTuple(num_args, args);
+  PyObject *names = keys != nullptr ? StrTuple(num_args, keys) : PyTuple_New(0);
+  int rc = Call("nd_save", nullptr, "(sOO)", fname, handles, names);
+  Py_DECREF(handles);
+  Py_DECREF(names);
+  return rc;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *pair = nullptr;
+  if (Call("nd_load", &pair, "(s)", fname) != 0) return -1;
+  PyObject *arrs = PyTuple_GetItem(pair, 0);   // borrowed
+  PyObject *names = PyTuple_GetItem(pair, 1);  // borrowed
+  int rc = HandleList(arrs, out_size, out_arr);
+  if (rc == 0) rc = StringList(names, out_name_size, out_names);
+  Py_DECREF(pair);
+  return rc;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) { return FreeHandle(handle); }
+
+// --------------------------------------------------------------- operators
+int MXImperativeInvokeByName(const char *op_name, int num_inputs,
+                             NDArrayHandle *inputs, int *num_outputs,
+                             NDArrayHandle **outputs, int num_params,
+                             const char **param_keys,
+                             const char **param_vals) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ins = ObjTuple(num_inputs, inputs);
+  PyObject *keys = StrTuple(num_params, param_keys);
+  PyObject *vals = StrTuple(num_params, param_vals);
+  PyObject *outs = nullptr;
+  int rc = Call("op_invoke", &outs, "(sOOO)", op_name, ins, keys, vals);
+  Py_DECREF(ins);
+  Py_DECREF(keys);
+  Py_DECREF(vals);
+  if (rc != 0) return -1;
+  mx_uint n = 0;
+  void **arr = nullptr;
+  rc = HandleList(outs, &n, &arr);
+  Py_DECREF(outs);
+  if (rc != 0) return -1;
+  *num_outputs = static_cast<int>(n);
+  *outputs = arr;
+  return 0;
+}
+
+// ------------------------------------------------------------------ Symbol
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  // creators are interned name strings; stable for process lifetime
+  static std::vector<std::string> names;
+  static std::vector<void *> creators;
+  if (names.empty()) {
+    PyObject *lst = nullptr;
+    if (Call("op_names", &lst, "()") != 0) return -1;
+    Py_ssize_t n = PySequence_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *item = PySequence_GetItem(lst, i);
+      const char *s = item != nullptr ? PyUnicode_AsUTF8(item) : nullptr;
+      if (s != nullptr) names.emplace_back(s);
+      Py_XDECREF(item);
+    }
+    Py_DECREF(lst);
+    for (auto &s : names) creators.push_back(&s);
+  }
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = static_cast<std::string *>(creator)->c_str();
+  return 0;
+}
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out) {
+  MXTPUGil gil;
+  const char *name = static_cast<std::string *>(creator)->c_str();
+  PyObject *k = StrTuple(num_param, keys);
+  PyObject *v = StrTuple(num_param, vals);
+  PyObject *ret = nullptr;
+  int rc = Call("sym_create", &ret, "(sOOs)", name, k, v, "");
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (rc != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("sym_variable", &ret, "(s)", name) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  // reference semantics: composes IN PLACE; here the composed symbol
+  // replaces the handle's target object
+  MXTPUGil gil;
+  // args may themselves be composed atomics — unwrap to real Symbols
+  PyObject *argt = PyTuple_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i)
+    PyTuple_SET_ITEM(argt, i, ResolveMaybeComposed(
+                                  static_cast<PyObject *>(args[i])));
+  PyObject *names = keys != nullptr ? StrTuple(num_args, keys)
+                                    : PyTuple_New(0);
+  PyObject *composed = nullptr;
+  int rc = Call("sym_compose", &composed, "(OsOO)", sym,
+                name != nullptr ? name : "", names, argt);
+  Py_DECREF(argt);
+  Py_DECREF(names);
+  if (rc != 0) return -1;
+  // swap the handle's referent: the caller's SymbolHandle now points at
+  // the composed symbol; the deferred atomic is released
+  PyObject *old = static_cast<PyObject *>(sym);
+  // transplant composed's state onto the old handle is not possible for
+  // arbitrary objects; instead stash the composed object on the atomic
+  PyObject_SetAttrString(old, "composed", composed);
+  Py_DECREF(composed);
+  return 0;
+}
+
+static PyObject *ResolveSymbol(void *handle) {
+  return ResolveMaybeComposed(static_cast<PyObject *>(handle));
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("sym_from_json", &ret, "(s)", json) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle sym, const char **out_json) {
+  MXTPUGil gil;
+  PyObject *obj = ResolveSymbol(sym);
+  PyObject *ret = nullptr;
+  int rc = Call("sym_to_json", &ret, "(O)", obj);
+  Py_DECREF(obj);
+  if (rc != 0) return -1;
+  const char *s = PyUnicode_AsUTF8(ret);
+  if (s == nullptr) {
+    Py_DECREF(ret);
+    return MXTPUFail("MXSymbolSaveToJSON");
+  }
+  tl_json = s;
+  Py_DECREF(ret);
+  *out_json = tl_json.c_str();
+  return 0;
+}
+
+static int SymbolStrList(const char *fn, SymbolHandle sym,
+                         mx_uint *out_size, const char ***out_array) {
+  MXTPUGil gil;
+  PyObject *obj = ResolveSymbol(sym);
+  PyObject *lst = nullptr;
+  int rc = Call(fn, &lst, "(O)", obj);
+  Py_DECREF(obj);
+  if (rc != 0) return -1;
+  rc = StringList(lst, out_size, out_array);
+  Py_DECREF(lst);
+  return rc;
+}
+
+int MXSymbolListArguments(SymbolHandle sym, mx_uint *out_size,
+                          const char ***out_array) {
+  return SymbolStrList("sym_list_arguments", sym, out_size, out_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle sym, mx_uint *out_size,
+                        const char ***out_array) {
+  return SymbolStrList("sym_list_outputs", sym, out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle sym, mx_uint *out_size,
+                                const char ***out_array) {
+  return SymbolStrList("sym_list_aux", sym, out_size, out_array);
+}
+
+int MXSymbolFree(SymbolHandle handle) { return FreeHandle(handle); }
+
+// ---------------------------------------------------------------- Executor
+int MXExecutorSimpleBind(SymbolHandle sym, int dev_type, int dev_id,
+                         mx_uint num_args, const char **arg_names,
+                         const mx_uint *shape_indptr,
+                         const mx_uint *shape_data, const char *grad_req,
+                         ExecutorHandle *out) {
+  MXTPUGil gil;
+  PyObject *obj = ResolveSymbol(sym);
+  PyObject *names = StrTuple(num_args, arg_names);
+  PyObject *shapes = PyTuple_New(num_args);
+  for (mx_uint i = 0; i < num_args; ++i) {
+    mx_uint lo = shape_indptr[i], hi = shape_indptr[i + 1];
+    PyObject *tup = PyTuple_New(hi - lo);
+    for (mx_uint j = lo; j < hi; ++j)
+      PyTuple_SET_ITEM(tup, j - lo,
+                       PyLong_FromUnsignedLong(shape_data[j]));
+    PyTuple_SET_ITEM(shapes, i, tup);
+  }
+  PyObject *ret = nullptr;
+  int rc = Call("executor_simple_bind", &ret, "(OiiOOs)", obj, dev_type,
+                dev_id, names, shapes,
+                grad_req != nullptr ? grad_req : "write");
+  Py_DECREF(obj);
+  Py_DECREF(names);
+  Py_DECREF(shapes);
+  if (rc != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+static int ExecutorNDLookup(const char *fn, ExecutorHandle exec,
+                            const char *name, NDArrayHandle *out) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call(fn, &ret, "(Os)", exec, name) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXExecutorGetArg(ExecutorHandle exec, const char *name,
+                     NDArrayHandle *out) {
+  return ExecutorNDLookup("executor_arg", exec, name, out);
+}
+
+int MXExecutorGetGrad(ExecutorHandle exec, const char *name,
+                      NDArrayHandle *out) {
+  return ExecutorNDLookup("executor_grad", exec, name, out);
+}
+
+int MXExecutorGetAux(ExecutorHandle exec, const char *name,
+                     NDArrayHandle *out) {
+  return ExecutorNDLookup("executor_aux", exec, name, out);
+}
+
+int MXExecutorForward(ExecutorHandle exec, int is_train) {
+  return Call("executor_forward", nullptr, "(Oi)", exec, is_train);
+}
+
+int MXExecutorBackward(ExecutorHandle exec, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  MXTPUGil gil;
+  PyObject *grads = ObjTuple(len, head_grads);
+  int rc = Call("executor_backward", nullptr, "(OO)", exec, grads);
+  Py_DECREF(grads);
+  return rc;
+}
+
+int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  MXTPUGil gil;
+  PyObject *lst = nullptr;
+  if (Call("executor_outputs", &lst, "(O)", exec) != 0) return -1;
+  int rc = HandleList(lst, out_size, reinterpret_cast<void ***>(out));
+  Py_DECREF(lst);
+  return rc;
+}
+
+int MXExecutorFree(ExecutorHandle handle) { return FreeHandle(handle); }
+
+// ----------------------------------------------------------------- KVStore
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("kv_create", &ret, "(s)", type) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  for (mx_uint i = 0; i < num; ++i)
+    if (Call("kv_init", nullptr, "(OiO)", kv, keys[i], vals[i]) != 0)
+      return -1;
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  for (mx_uint i = 0; i < num; ++i)
+    if (Call("kv_push", nullptr, "(OiOi)", kv, keys[i], vals[i],
+             priority) != 0)
+      return -1;
+  return 0;
+}
+
+int MXKVStorePull(KVStoreHandle kv, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  for (mx_uint i = 0; i < num; ++i)
+    if (Call("kv_pull", nullptr, "(OiOi)", kv, keys[i], vals[i],
+             priority) != 0)
+      return -1;
+  return 0;
+}
+
+static int KVInt(const char *fn, KVStoreHandle kv, int *out) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call(fn, &ret, "(O)", kv) != 0) return -1;
+  *out = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXKVStoreGetRank(KVStoreHandle kv, int *rank) {
+  return KVInt("kv_rank", kv, rank);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle kv, int *size) {
+  return KVInt("kv_size", kv, size);
+}
+
+int MXKVStoreFree(KVStoreHandle handle) { return FreeHandle(handle); }
+
+}  // extern "C"

@@ -1,0 +1,79 @@
+// Shared CPython-embedding plumbing for the mxtpu C ABI translation
+// units (mxtpu_c_api.cc: predict surface; mxtpu_c_core.cc: NDArray/
+// Symbol/Executor/KVStore core).  The reference's C API threads errors
+// through a thread-local buffer returned by MXGetLastError
+// (src/c_api/c_api_error.cc) — same contract here.
+#ifndef MXTPU_PY_H_
+#define MXTPU_PY_H_
+
+#include <Python.h>
+
+#include <mutex>
+#include <string>
+
+// thread-local last-error buffer (defined in mxtpu_c_api.cc)
+extern thread_local std::string mxtpu_last_error;
+
+inline void MXTPUEnsurePython() {
+  static std::once_flag once;
+  std::call_once(once, []() {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // release the GIL acquired by initialization so that
+      // PyGILState_Ensure works from any thread afterwards
+      PyEval_SaveThread();
+    }
+  });
+}
+
+class MXTPUGil {
+ public:
+  MXTPUGil() { state_ = PyGILState_Ensure(); }
+  ~MXTPUGil() { PyGILState_Release(state_); }
+
+ private:
+  PyGILState_STATE state_;
+};
+
+// Record `where` (+ any pending Python exception) into the last-error
+// buffer and return -1.  Must be called with the GIL held.
+inline int MXTPUFail(const char *where) {
+  std::string msg = where;
+  if (PyErr_Occurred()) {
+    PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+    PyErr_Fetch(&type, &value, &tb);
+    PyErr_NormalizeException(&type, &value, &tb);
+    if (value != nullptr) {
+      PyObject *s = PyObject_Str(value);
+      if (s != nullptr) {
+        const char *utf8 = PyUnicode_AsUTF8(s);
+        if (utf8 != nullptr) {
+          msg += ": ";
+          msg += utf8;
+        } else {
+          PyErr_Clear();
+        }
+        Py_DECREF(s);
+      }
+    }
+    Py_XDECREF(type);
+    Py_XDECREF(value);
+    Py_XDECREF(tb);
+  }
+  mxtpu_last_error = msg;
+  return -1;
+}
+
+// Call mxnet_tpu.c_api_support.<fn>(*args) -> new reference or nullptr.
+inline PyObject *MXTPUSupportCall(const char *fn, PyObject *args) {
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu.c_api_support");
+  if (mod == nullptr) return nullptr;
+  PyObject *f = PyObject_GetAttrString(mod, fn);
+  Py_DECREF(mod);
+  if (f == nullptr) return nullptr;
+  PyObject *ret = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  return ret;
+}
+
+#endif  // MXTPU_PY_H_

@@ -94,3 +94,207 @@ def test_c_predict_api(tmp_path):
                                        {"data": (2, 8)}).predict(data=x)[0]
     np.testing.assert_allclose(out, expect, rtol=1e-5)
     assert lib.MXPredFree(handle) == 0
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_core_symbol_bind_forward():
+    """Build a symbol, bind, and run forward/backward through the C ABI
+    core (the reference c_api.h choke-point contract)."""
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    # data variable
+    data = ctypes.c_void_p()
+    ok(lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+
+    # find the FullyConnected creator
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    ok(lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                            ctypes.byref(creators)))
+    fc_creator = None
+    name_p = ctypes.c_char_p()
+    for i in range(n.value):
+        ok(lib.MXSymbolGetAtomicSymbolName(ctypes.c_void_p(creators[i]),
+                                           ctypes.byref(name_p)))
+        if name_p.value == b"FullyConnected":
+            fc_creator = ctypes.c_void_p(creators[i])
+    assert fc_creator is not None and n.value > 200
+
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"3")
+    ok(lib.MXSymbolCreateAtomicSymbol(fc_creator, 1, keys, vals,
+                                      ctypes.byref(fc)))
+    arg_keys = (ctypes.c_char_p * 1)(b"data")
+    arg_vals = (ctypes.c_void_p * 1)(data)
+    ok(lib.MXSymbolCompose(fc, b"fc", 1, arg_keys, arg_vals))
+
+    # arguments round-trip
+    size = ctypes.c_uint()
+    strs = ctypes.POINTER(ctypes.c_char_p)()
+    ok(lib.MXSymbolListArguments(fc, ctypes.byref(size), ctypes.byref(strs)))
+    args = [strs[i].decode() for i in range(size.value)]
+    assert args == ["data", "fc_weight", "fc_bias"]
+
+    # JSON round trip
+    json_p = ctypes.c_char_p()
+    ok(lib.MXSymbolSaveToJSON(fc, ctypes.byref(json_p)))
+    sym2 = ctypes.c_void_p()
+    ok(lib.MXSymbolCreateFromJSON(json_p, ctypes.byref(sym2)))
+
+    # bind: data (2,4)
+    exec_h = ctypes.c_void_p()
+    in_keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(2, 4)
+    ok(lib.MXExecutorSimpleBind(fc, 1, 0, 1, in_keys, indptr, shape_data,
+                                b"write", ctypes.byref(exec_h)))
+
+    # fill args through the C ABI
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 4).astype("f")
+    w = rng.randn(3, 4).astype("f")
+    b = rng.randn(3).astype("f")
+    for name, val in [(b"data", x), (b"fc_weight", w), (b"fc_bias", b)]:
+        h = ctypes.c_void_p()
+        ok(lib.MXExecutorGetArg(exec_h, name, ctypes.byref(h)))
+        ok(lib.MXNDArraySyncCopyFromCPU(
+            h, val.ctypes.data_as(ctypes.c_void_p), val.size))
+        lib.MXNDArrayFree(h)
+
+    ok(lib.MXExecutorForward(exec_h, 1))
+    n_out = ctypes.c_uint()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ok(lib.MXExecutorOutputs(exec_h, ctypes.byref(n_out),
+                             ctypes.byref(outs)))
+    assert n_out.value == 1
+    got = np.zeros((2, 3), "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]), got.ctypes.data_as(ctypes.c_void_p),
+        got.size))
+    np.testing.assert_allclose(got, x @ w.T + b, rtol=1e-5)
+
+    ok(lib.MXExecutorBackward(exec_h, 0, None))
+    g = ctypes.c_void_p()
+    ok(lib.MXExecutorGetGrad(exec_h, b"fc_weight", ctypes.byref(g)))
+    gw = np.zeros((3, 4), "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        g, gw.ctypes.data_as(ctypes.c_void_p), gw.size))
+    np.testing.assert_allclose(gw, np.ones((2, 3), "f").T @ x, rtol=1e-4)
+
+    lib.MXExecutorFree(exec_h)
+    lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(sym2)
+    lib.MXSymbolFree(data)
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_core_imperative_and_kvstore():
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    # NDArray create + fill
+    shape = (ctypes.c_uint * 2)(2, 3)
+    a = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(a)))
+    xs = np.arange(6, dtype="f").reshape(2, 3)
+    ok(lib.MXNDArraySyncCopyFromCPU(
+        a, xs.ctypes.data_as(ctypes.c_void_p), xs.size))
+    dim = ctypes.c_uint()
+    pshape = ctypes.POINTER(ctypes.c_uint)()
+    ok(lib.MXNDArrayGetShape(a, ctypes.byref(dim), ctypes.byref(pshape)))
+    assert [pshape[i] for i in range(dim.value)] == [2, 3]
+
+    # imperative: sqrt(a + a)
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 2)(a, a)
+    ok(lib.MXImperativeInvokeByName(b"_plus", 2, ins, ctypes.byref(n_out),
+                                    ctypes.byref(outs), 0, None, None))
+    assert n_out.value == 1
+    summed = ctypes.c_void_p(outs[0])
+    ins1 = (ctypes.c_void_p * 1)(summed)
+    ok(lib.MXImperativeInvokeByName(b"sqrt", 1, ins1, ctypes.byref(n_out),
+                                    ctypes.byref(outs), 0, None, None))
+    got = np.zeros((2, 3), "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        ctypes.c_void_p(outs[0]), got.ctypes.data_as(ctypes.c_void_p),
+        got.size))
+    np.testing.assert_allclose(got, np.sqrt(2 * xs), rtol=1e-5)
+
+    # kvstore local: init/push/pull through the ABI
+    kv = ctypes.c_void_p()
+    ok(lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    rank = ctypes.c_int()
+    ok(lib.MXKVStoreGetRank(kv, ctypes.byref(rank)))
+    assert rank.value == 0
+    key = (ctypes.c_int * 1)(7)
+    vals = (ctypes.c_void_p * 1)(a)
+    ok(lib.MXKVStoreInit(kv, 1, key, vals))
+    ok(lib.MXKVStorePush(kv, 1, key, vals, 0))
+    out_nd = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(out_nd)))
+    pulls = (ctypes.c_void_p * 1)(out_nd)
+    ok(lib.MXKVStorePull(kv, 1, key, pulls, 0))
+    pulled = np.zeros((2, 3), "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        out_nd, pulled.ctypes.data_as(ctypes.c_void_p), pulled.size))
+    np.testing.assert_allclose(pulled, xs)
+    lib.MXKVStoreFree(kv)
+    lib.MXNDArrayFree(a)
+    lib.MXNDArrayFree(out_nd)
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_cpp_package_generated_wrappers():
+    """Build + run the C++ example that drives the generated op wrappers
+    (mxtpu_ops.hpp from tools/gen_cpp_wrappers.py) through the C ABI."""
+    import shutil
+    import subprocess
+    import sys
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cpp = os.path.join(root, "cpp-package")
+    assert os.path.exists(os.path.join(cpp, "include", "mxtpu_ops.hpp")), \
+        "run tools/gen_cpp_wrappers.py"
+    subprocess.run(["make", "-C", cpp], check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    res = subprocess.run([os.path.join(cpp, "ops_example")], env=env,
+                         capture_output=True, text=True, timeout=300,
+                         cwd=root)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "ops example OK" in res.stdout
+
+
+def test_wrapper_generator_is_current(tmp_path):
+    """The committed mxtpu_ops.hpp must match a fresh generation run
+    (registry drift would silently stale the cpp-package)."""
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = str(tmp_path / "ops.hpp")
+    subprocess.run([sys.executable,
+                    os.path.join(root, "tools", "gen_cpp_wrappers.py"),
+                    "-o", out], check=True, capture_output=True,
+                   cwd=root, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    with open(out) as f:
+        fresh = f.read()
+    with open(os.path.join(root, "cpp-package", "include",
+                           "mxtpu_ops.hpp")) as f:
+        committed = f.read()
+    assert fresh == committed, \
+        "cpp-package/include/mxtpu_ops.hpp is stale; re-run " \
+        "tools/gen_cpp_wrappers.py"

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Generate the cpp-package per-op wrappers from the op registry.
+
+The reference machine-generates its full cpp-package op surface from the
+C API's op metadata (``cpp-package/src/OpWrapperGenerator/
+OpWrapperGenerator.py``).  Same pipeline here: iterate the unified
+registry, map each op's typed Param spec onto a C++ signature, and emit
+``cpp-package/include/mxtpu_ops.hpp`` — every function a thin call into
+``mxtpu::Invoke`` (MXImperativeInvokeByName in the C ABI).
+
+    python tools/gen_cpp_wrappers.py [-o cpp-package/include/mxtpu_ops.hpp]
+"""
+import argparse
+import keyword
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir))
+
+HEADER = '''\
+// GENERATED FILE — do not edit.  Produced by tools/gen_cpp_wrappers.py
+// from the mxnet_tpu op registry (the analog of the reference's
+// cpp-package OpWrapperGenerator.py output).  Each function invokes its
+// operator through the C ABI (MXImperativeInvokeByName); inputs are
+// NDArrays, typed parameters serialize onto the registry's string
+// coercion layer, extra/optional parameters ride the trailing KWArgs.
+#ifndef MXTPU_OPS_HPP_
+#define MXTPU_OPS_HPP_
+
+#include <string>
+#include <vector>
+
+#include "mxtpu_cpp.hpp"
+
+namespace mxtpu {
+namespace op {
+'''
+
+FOOTER = '''\
+}  // namespace op
+}  // namespace mxtpu
+
+#endif  // MXTPU_OPS_HPP_
+'''
+
+CPP_KEYWORDS = {"new", "delete", "default", "register", "template",
+                "operator", "and", "or", "not", "xor", "this", "class"}
+
+
+def cpp_ident(name):
+    ident = re.sub(r"\W", "_", name)
+    if ident[0].isdigit() or ident in CPP_KEYWORDS or \
+            keyword.iskeyword(ident):
+        ident = "_" + ident
+    return ident
+
+
+def param_cpp(param):
+    """(cpp_type, serializer_expr) for a registry Param."""
+    t = param.type
+    if t is int:
+        return "int", "std::to_string({v})"
+    if t is float:
+        return "double", "FloatStr({v})"
+    if t is bool:
+        return "bool", '({v} ? "1" : "0")'
+    if t == "shape":
+        return "const Shape &", "{v}.str()"
+    # str, dtype, enums, floats-tuples: pass through as strings
+    return "const std::string &", "{v}"
+
+
+def emit_op(op):
+    fn_name = cpp_ident(op.name)
+    required = [p for p in op.params_spec if p.required]
+    lines = []
+    args = ["const std::vector<NDArray> &inputs"]
+    packs = []
+    for p in required:
+        cpp_t, ser = param_cpp(p)
+        arg = cpp_ident(p.name)
+        args.append("%s %s" % (cpp_t, arg))
+        packs.append('  kw["%s"] = %s;' % (p.name, ser.format(v=arg)))
+    args.append("const KWArgs &extra = {}")
+    lines.append("inline std::vector<NDArray> %s(" % fn_name)
+    lines.append("    " + ",\n    ".join(args) + ") {")
+    lines.append("  KWArgs kw(extra);")
+    lines.extend(packs)
+    lines.append('  return Invoke("%s", inputs, kw);' % op.name)
+    lines.append("}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-o", "--output",
+                    default=os.path.join(os.path.dirname(
+                        os.path.abspath(__file__)), os.pardir,
+                        "cpp-package", "include", "mxtpu_ops.hpp"))
+    opts = ap.parse_args()
+
+    import mxnet_tpu  # noqa: F401 — populates the registry
+    from mxnet_tpu.op import registry
+
+    chunks = [HEADER]
+    emitted = set()
+    for name in sorted(registry._REGISTRY):
+        op = registry._REGISTRY[name]
+        ident = cpp_ident(name)
+        if ident in emitted:
+            continue
+        emitted.add(ident)
+        chunks.append(emit_op(op))
+    # aliases become inline forwarders to their target's registry name
+    chunks.append("// ---- aliases ----")
+    for alias_name in sorted(registry._ALIASES):
+        ident = cpp_ident(alias_name)
+        if ident in emitted:
+            continue
+        emitted.add(ident)
+        op = registry.get(alias_name)
+        chunks.append(emit_op(_AliasView(alias_name, op)))
+    chunks.append(FOOTER)
+    with open(opts.output, "w") as f:
+        f.write("\n".join(chunks))
+    print("wrote %s (%d wrappers)" % (opts.output, len(emitted)))
+
+
+class _AliasView:
+    """Present an alias under its own name with the target's params."""
+
+    def __init__(self, name, target):
+        self.name = name
+        self.params_spec = target.params_spec
+
+
+if __name__ == "__main__":
+    main()
