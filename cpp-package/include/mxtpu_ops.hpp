@@ -484,6 +484,13 @@ inline std::vector<NDArray> _identity_with_attr_like_rhs(
   return Invoke("_identity_with_attr_like_rhs", inputs, kw);
 }
 
+inline std::vector<NDArray> _imdecode(
+    const std::vector<NDArray> &inputs,
+    const KWArgs &extra = {}) {
+  KWArgs kw(extra);
+  return Invoke("_imdecode", inputs, kw);
+}
+
 inline std::vector<NDArray> _lesser(
     const std::vector<NDArray> &inputs,
     const KWArgs &extra = {}) {

@@ -17,16 +17,20 @@ from . import vgg
 from . import resnet
 from . import inception_bn
 from . import inception_v3
+from . import googlenet
 from . import lstm_lm
+from . import resnext
 from . import transformer
 
 __all__ = ["get_symbol", "mlp", "lenet", "alexnet", "vgg", "resnet",
-           "inception_bn", "inception_v3", "lstm_lm", "transformer"]
+           "resnext", "googlenet", "inception_bn", "inception_v3",
+           "lstm_lm", "transformer"]
 
 _BUILDERS = {
     "mlp": mlp.get_symbol,
     "lenet": lenet.get_symbol,
     "alexnet": alexnet.get_symbol,
+    "googlenet": googlenet.get_symbol,
     "inception-bn": inception_bn.get_symbol,
     "inception-v3": inception_v3.get_symbol,
     "transformer": transformer.get_symbol,
@@ -43,6 +47,11 @@ def get_symbol(network, num_classes=1000, **kwargs):
     """
     if network in _BUILDERS:
         return _BUILDERS[network](num_classes=num_classes, **kwargs)
+    if network.startswith("resnext"):
+        depth = int(network.split("-")[1]) if "-" in network else \
+            int(kwargs.pop("num_layers", 50))
+        return resnext.get_symbol(num_classes=num_classes,
+                                  num_layers=depth, **kwargs)
     if network.startswith("resnet"):
         depth = int(network.split("-")[1]) if "-" in network else \
             int(kwargs.pop("num_layers", 50))
@@ -53,5 +62,5 @@ def get_symbol(network, num_classes=1000, **kwargs):
             int(kwargs.pop("num_layers", 16))
         return vgg.get_symbol(num_classes=num_classes, num_layers=depth,
                               **kwargs)
-    raise ValueError("unknown network %r (have %s, resnet-N, vgg-N)"
-                     % (network, sorted(_BUILDERS)))
+    raise ValueError("unknown network %r (have %s, resnet-N, resnext-N, "
+                     "vgg-N)" % (network, sorted(_BUILDERS)))

@@ -264,3 +264,36 @@ def _corr_infer_shape(p, in_shapes):
 
 
 _REGISTRY["Correlation"].infer_shape = _corr_infer_shape
+
+
+# ----------------------------------------------------------------------
+# _imdecode: image decode as an operator (reference ``src/io/image_io.cc``
+# registers ``_imdecode`` so ``mx.image`` can decode through the op
+# namespace).  Decoding is host-side by nature, so this op is
+# imperative-only: it consumes a concrete uint8 buffer array and returns
+# the decoded HWC image; invoking it inside a traced program raises.
+@register("_imdecode",
+          params_spec=(Param("index", int, 0),
+                       Param("x0", int, 0), Param("y0", int, 0),
+                       Param("x1", int, 0), Param("y1", int, 0),
+                       Param("c", int, 0), Param("size", int, 0),
+                       Param("flag", int, 1),
+                       Param("to_rgb", bool, True)),
+          input_names=("buf",), hint="imdecode")
+def _imdecode_op(p, ctx, buf):
+    import jax.core as _core
+    if isinstance(buf, _core.Tracer):
+        raise MXNetError(
+            "_imdecode is imperative-only: image decoding is host-side "
+            "and its output shape depends on the payload (reference "
+            "image_io.cc behavior)")
+    from ..image import _imdecode_np
+    raw = np.asarray(buf).astype(np.uint8).tobytes()
+    if p["size"]:
+        raw = raw[:p["size"]]
+    img = _imdecode_np(raw, p["flag"], p["to_rgb"])
+    if p["x1"] > p["x0"] and p["y1"] > p["y0"]:
+        img = img[p["y0"]:p["y1"], p["x0"]:p["x1"]]
+    if p["c"] > 0:
+        img = img[:, :, :p["c"]]
+    return jnp.asarray(img)

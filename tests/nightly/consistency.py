@@ -66,6 +66,8 @@ def main():
     for idx, case in enumerate(sweep.CASES):
         if idx % opts.sample:
             continue
+        if case["kind"] == "imp":
+            continue                     # imperative-only (host-side) op
         op = _registry.get(case["op"])
         if op.uses_rng and case["params"].get("p") != 0.0:
             continue                     # sampler draws are backend-keyed

@@ -1,0 +1,38 @@
+"""Deprecated reference-era op names keep working unmodified
+(``Softmax`` alias of SoftmaxOutput, ``ElementWiseSum``,
+``Convolution_v1``/``Pooling_v1`` — reference src/operator/
+softmax_output.cc, elementwise_sum.cc, *_v1 registrations)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import io
+
+
+def test_reference_era_script_runs():
+    """A v0.9-style conv net written with deprecated names trains."""
+    rng = np.random.RandomState(0)
+    n = 96
+    x = rng.randn(n, 1, 8, 8).astype("f")
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype("f")
+
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution_v1(data=data, kernel=(3, 3), num_filter=4,
+                                 pad=(1, 1), name="conv1")
+    act = mx.sym.Activation(data=conv, act_type="relu")
+    pool = mx.sym.Pooling_v1(data=act, kernel=(2, 2), stride=(2, 2),
+                             pool_type="max")
+    skip = mx.sym.Pooling_v1(data=data, kernel=(2, 2), stride=(2, 2),
+                             pool_type="avg")
+    skip = mx.sym.Convolution_v1(data=skip, kernel=(1, 1), num_filter=4,
+                                 name="proj")
+    merged = mx.sym.ElementWiseSum(pool, skip, num_args=2)
+    flat = mx.sym.Flatten(data=merged)
+    fc = mx.sym.FullyConnected(data=flat, num_hidden=2, name="fc")
+    net = mx.sym.Softmax(data=fc, name="softmax")   # deprecated loss name
+
+    it = io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=15, optimizer_params={"learning_rate": 0.3},
+            initializer=mx.init.Xavier())
+    it.reset()
+    assert mod.score(it, "acc")[0][1] > 0.9

@@ -1,0 +1,95 @@
+"""Custom python operators + the _imdecode operator (reference
+``src/operator/custom/custom-inl.h`` / ``python/mxnet/operator.py`` and
+``src/io/image_io.cc``)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+@mx.operator.register("scaled_sigmoid")
+class ScaledSigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self, scale="1.0"):
+        super().__init__(need_top_grad=True)
+        self.scale = float(scale)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        scale = self.scale
+
+        class ScaledSigmoid(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0].asnumpy()
+                self.assign(out_data[0], req[0],
+                            mx.nd.array(scale / (1 + np.exp(-x))))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                y = out_data[0].asnumpy() / scale
+                g = out_grad[0].asnumpy()
+                self.assign(in_grad[0], req[0],
+                            mx.nd.array(g * scale * y * (1 - y)))
+
+        return ScaledSigmoid()
+
+
+def test_custom_op_imperative():
+    x = np.random.RandomState(0).randn(3, 4).astype("f")
+    out = mx.nd.Custom(mx.nd.array(x), op_type="scaled_sigmoid",
+                       scale="2.0")
+    np.testing.assert_allclose(out.asnumpy(), 2 / (1 + np.exp(-x)),
+                               rtol=1e-5)
+
+
+def test_custom_op_symbolic_forward_backward():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.Custom(data, op_type="scaled_sigmoid", scale="1.0",
+                        name="cs")
+    args = {"data": mx.nd.array(x)}
+    grads = {"data": mx.nd.zeros(x.shape)}
+    ex = net.bind(mx.cpu(), args=args, args_grad=grads)
+    ex.forward(is_train=True)
+    y = 1 / (1 + np.exp(-x))
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), y, rtol=1e-5)
+    ex.backward([mx.nd.ones(x.shape)])
+    np.testing.assert_allclose(grads["data"].asnumpy(), y * (1 - y),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_custom_op_in_module_training():
+    """sym.Custom participates in a fit() loop end-to-end."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 6).astype("f")
+    w = rng.randn(6, 2).astype("f")
+    y = np.argmax(x @ w, 1).astype("f")
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=8, name="fc1")
+    h = mx.sym.Custom(h, op_type="scaled_sigmoid", scale="1.0")
+    h = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(h, name="softmax")
+    it = mx.io.NDArrayIter(x, y, batch_size=16)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=10, optimizer_params={"learning_rate": 0.5},
+            initializer=mx.init.Xavier())
+    it.reset()
+    assert mod.score(it, "acc")[0][1] > 0.9
+
+
+def test_imdecode_operator():
+    pil = pytest.importorskip("PIL.Image")
+    import io as _io
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 255, (5, 7, 3)).astype("uint8")
+    buf = _io.BytesIO()
+    pil.fromarray(img).save(buf, format="PNG")
+    raw = np.frombuffer(buf.getvalue(), dtype=np.uint8)
+
+    out = mx.nd._imdecode(mx.nd.array(raw.astype("f")))
+    np.testing.assert_array_equal(out.asnumpy().astype("uint8"), img)
+
+    # crop window + channel clamp params
+    out2 = mx.nd._imdecode(mx.nd.array(raw.astype("f")),
+                           x0=1, y0=1, x1=4, y1=3, c=2)
+    np.testing.assert_array_equal(out2.asnumpy().astype("uint8"),
+                                  img[1:3, 1:4, :2])

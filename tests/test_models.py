@@ -14,6 +14,8 @@ from mxnet_tpu import models
     ("vgg-11", (2, 3, 224, 224)),
     ("resnet-18", (2, 3, 224, 224)),
     ("resnet-50", (2, 3, 224, 224)),
+    ("googlenet", (2, 3, 224, 224)),
+    ("resnext-50", (2, 3, 224, 224)),
     ("inception-bn", (2, 3, 224, 224)),
     ("inception-v3", (2, 3, 299, 299)),
 ])

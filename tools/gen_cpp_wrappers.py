@@ -108,6 +108,8 @@ def main():
     chunks = [HEADER]
     emitted = set()
     for name in sorted(registry._REGISTRY):
+        if name.startswith("Custom["):
+            continue                     # dynamic per-user registrations
         op = registry._REGISTRY[name]
         ident = cpp_ident(name)
         if ident in emitted:
