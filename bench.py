@@ -25,6 +25,7 @@ def main():
     # fuse the Module step on every backend (the default for tpu contexts)
     os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
     import jax
+    import jax.numpy as jnp
     import mxnet_tpu as mx
     from mxnet_tpu import io, models
 
@@ -37,13 +38,17 @@ def main():
         on_tpu = False
     batch = 256 if on_tpu else 16
     image = 224 if on_tpu else 64
-    steps = 20 if on_tpu else 3
+    # enough steps that the ~80ms tunnel drain latency at the end is <2%
+    # of the timed region (it is serial with the last step, not hidden)
+    steps = 50 if on_tpu else 3
 
     # channels-last: the TPU-native layout (lanes = channels keeps convs
     # on the MXU without relayout transposes); ~6% over NCHW here.  The
-    # remaining ceiling is this chip's HBM roofline: measured ~227 GB/s
-    # and ~90-100 TF/s bf16 matmul peak through the tunnel — ResNet-50's
-    # early low-channel stages are bandwidth-bound at those rates.
+    # remaining ceiling is HBM bandwidth: tools/roofline.py measures this
+    # chip at ~181 TF/s bf16 / ~587 GB/s (ROOFLINE.json); XLA's cost
+    # analysis puts the step's byte traffic at the bandwidth roofline, so
+    # the step runs ~30% MFU — ResNet's low-arithmetic-intensity stages
+    # (stem, BN, early blocks) are bandwidth-bound, not MXU-bound.
     sym = models.get_symbol("resnet-50", num_classes=1000, layout="NHWC")
     ctx = mx.tpu() if on_tpu else mx.cpu()
     mod = mx.mod.Module(context=ctx, symbol=sym, compute_dtype="bfloat16")
@@ -73,8 +78,8 @@ def main():
         mod.update()
         mod.update_metric(metric, data_batch.label)
 
-    for _ in range(2):       # warmup (compile)
-        one_step()
+    for _ in range(3):       # warmup: compile + the one-time relayout
+        one_step()           # recompile when donated buffers come back
     metric.get()
     metric.reset()
 
@@ -88,12 +93,34 @@ def main():
     elapsed = time.perf_counter() - t0
 
     img_s = batch * steps / elapsed
-    print(json.dumps({
+    line = {
         "metric": "resnet50_train_img_per_sec_per_chip",
         "value": round(img_s, 2),
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
-    }))
+    }
+    # MFU vs the measured chip peak (tools/roofline.py artifact): step
+    # flops from XLA's own cost analysis over the same accounting that
+    # measured the peak
+    try:
+        roof = json.load(open(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "ROOFLINE.json")))
+        t = mod._trainer
+        comp = t._step_fn.lower(
+            t.params, t.aux, t.opt_state,
+            {k: v.data for k, v in
+             zip(["data", "softmax_label"], data_batch.data + data_batch.label)},
+            jnp.float32(0.1), jnp.int32(1), t._key).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        step_tflops = ca.get("flops", 0.0) * (img_s / batch) / 1e12
+        line["achieved_tflops"] = round(step_tflops, 1)
+        line["mfu_vs_measured_peak"] = round(
+            step_tflops / roof["bf16_matmul_tflops"], 3)
+    except Exception:
+        pass
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

@@ -21,6 +21,12 @@ python tools/launch.py -n 2 --launcher local -- \
 python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_mlp.py
 
+echo "=== crash-restart recovery (auto-restart orchestration) ==="
+RESUME_DIR="$(mktemp -d)"
+trap 'rm -rf "$RESUME_DIR"' EXIT
+python tools/launch.py -n 2 --launcher local --auto-restart 1 -- \
+    python tests/nightly/dist_resume.py "$RESUME_DIR"
+
 echo "=== cpu-vs-tpu consistency ==="
 python tests/nightly/consistency.py
 

@@ -1,90 +1,92 @@
-"""Learning-rate schedulers (reference ``python/mxnet/lr_scheduler.py``).
+"""Learning-rate schedulers: cumulative update count -> learning rate.
 
-A scheduler maps the cumulative update count -> learning rate; the Optimizer
-calls it per update.  Because the fused TPU train step bakes the lr in as a
-scalar operand (not a traced constant), changing the lr between steps does
-NOT trigger recompilation.
+API parity with the reference's ``python/mxnet/lr_scheduler.py``
+(FactorScheduler / MultiFactorScheduler and their decay boundaries:
+the rate drops once ``num_update`` strictly exceeds a boundary).  Unlike
+the reference — which mutates ``base_lr`` inside a while-loop state
+machine — every scheduler here computes the rate as a pure function of
+``num_update``: idempotent, safe to query out of order, and the natural
+shape for the fused TPU train step, which feeds the lr in as a scalar
+operand each step (so changing it never retraces the XLA program).
 """
 from __future__ import annotations
 
+import bisect
 import logging
 
 
-class LRScheduler(object):
-    """Base scheduler: ``__call__(num_update) -> lr``."""
+class LRScheduler:
+    """Base: ``scheduler(num_update) -> lr``.
+
+    Subclasses implement ``_decays(num_update)`` (how many decay
+    boundaries have been crossed) and optionally ``_floor()``.
+    ``base_lr`` is assigned by the Optimizer that owns the scheduler.
+    """
 
     def __init__(self, base_lr=0.01):
         self.base_lr = base_lr
+        self._logged_decays = 0     # logging watermark only, not lr state
+
+    def _decays(self, num_update):
+        raise NotImplementedError
+
+    def _floor(self):
+        return 0.0
 
     def __call__(self, num_update):
-        raise NotImplementedError("must override this")
+        k = self._decays(num_update)
+        lr = max(self.base_lr * self.factor ** k, self._floor())
+        if k > self._logged_decays:
+            self._logged_decays = k
+            logging.info("Update[%d]: Change learning rate to %0.5e",
+                         num_update, lr)
+        return lr
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every ``step`` updates (reference ``lr_scheduler.py:36``)."""
+    """Geometric decay every ``step`` updates
+    (reference ``lr_scheduler.py:36``): boundary ``i`` sits at
+    ``i * step`` and applies once ``num_update`` passes it."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8):
         super().__init__()
-        if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1 round")
+        if int(step) < 1:
+            raise ValueError("step must be >= 1 update")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
+            raise ValueError("factor must be <= 1 so the lr decays")
+        self.step = int(step)
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-                logging.info("Update[%d]: now learning rate arrived at %0.5e, "
-                             "will not change in the future", num_update,
-                             self.base_lr)
-            else:
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-        return self.base_lr
+    def _decays(self, num_update):
+        return max(0, (int(num_update) - 1) // self.step)
+
+    def _floor(self):
+        return self.stop_factor_lr
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each milestone in ``step`` (reference
-    ``lr_scheduler.py:77``)."""
+    """Decay at explicit milestones (reference ``lr_scheduler.py:77``)."""
 
     def __init__(self, step, factor=1):
         super().__init__()
-        if not isinstance(step, list) or len(step) < 1:
-            raise ValueError("step must be a list with at least one entry")
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing integer list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step) or sorted(set(step)) != list(step):
+            raise ValueError("step must be strictly increasing, each >= 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
+            raise ValueError("factor must be <= 1 so the lr decays")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-                logging.info("Update[%d]: Change learning rate to %0.5e",
-                             num_update, self.base_lr)
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _decays(self, num_update):
+        # milestones strictly below num_update have been crossed
+        return bisect.bisect_left(self.step, int(num_update))
 
 
 class PolyScheduler(LRScheduler):
-    """Polynomial decay to zero over ``max_update`` steps — TPU-era addition
-    commonly used for ResNet training recipes."""
+    """Polynomial decay to zero over ``max_update`` steps — TPU-era
+    addition used by ResNet training recipes."""
 
     def __init__(self, max_update, power=2):
         super().__init__()
@@ -92,6 +94,5 @@ class PolyScheduler(LRScheduler):
         self.power = power
 
     def __call__(self, num_update):
-        if num_update >= self.max_update:
-            return 0.0
-        return self.base_lr * (1 - num_update / self.max_update) ** self.power
+        remain = max(0.0, 1.0 - num_update / self.max_update)
+        return self.base_lr * remain ** self.power

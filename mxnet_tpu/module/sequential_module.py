@@ -1,67 +1,70 @@
-"""SequentialModule: chain modules head-to-tail.
+"""SequentialModule: run modules head-to-tail as one module.
 
-Reference: ``python/mxnet/module/sequential_module.py``.  Data flows
-through the chain in forward order and gradients back through it; each
-sub-module may take the previous module's outputs as its data.
+API parity with the reference's ``python/mxnet/module/sequential_module.py``
+(``add(module, take_labels=…, auto_wiring=…)``, META_* constants).  The
+chain here is held as a list of ``_Stage`` records rather than parallel
+module/meta lists, and the label bookkeeping is computed once at ``add``
+time instead of re-derived during bind.
+
+Chained modules exchange activations and out-grads host-side between
+stages, so each stage runs on the classic per-module executor path — the
+fused single-program train step only applies to a stand-alone ``Module``.
 """
 from __future__ import annotations
 
 import logging
+from collections import namedtuple
 
-from ..base import MXNetError
-from ..io import DataDesc, DataBatch
+from ..io import DataBatch, DataDesc
 from .base_module import BaseModule
+
+_Stage = namedtuple("_Stage", ["module", "take_labels", "auto_wiring"])
 
 
 class SequentialModule(BaseModule):
-    """A container chaining multiple modules together."""
+    """Container composing sub-modules sequentially."""
 
+    # meta keyword names, kept as class constants for reference parity
     META_TAKE_LABELS = "take_labels"
     META_AUTO_WIRING = "auto_wiring"
 
     def __init__(self, logger=logging):
         super().__init__(logger=logger)
-        self._modules = []
-        self._metas = []
+        self._stages = []
         self._label_shapes = None
-        self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith("META_")])
 
-    def add(self, module, **kwargs):
-        """Add a module to the chain (reference
-        ``sequential_module.py:48``)."""
-        self._modules.append(module)
-        # chained modules exchange activations/out_grads per step — that
-        # needs the classic executor path, not the fused one-program step
+    def add(self, module, **meta):
+        """Append ``module``; ``take_labels=True`` routes labels to it,
+        ``auto_wiring=True`` renames the previous stage's outputs to its
+        data names (reference ``sequential_module.py:48``)."""
+        unknown = set(meta) - {self.META_TAKE_LABELS, self.META_AUTO_WIRING}
+        if unknown:
+            raise ValueError("unknown meta keys %s" % sorted(unknown))
+        # stage boundaries round-trip activations through the host; force
+        # the classic executor path on fused-capable modules
         if hasattr(module, "_fused_mode"):
             module._fused_mode = "never"
-
-        for key in kwargs:
-            assert key in self._meta_keys, ("Unknown meta \"%s\", a typo?" % key)
-        self._metas.append(kwargs)
+        self._stages.append(_Stage(module,
+                                   bool(meta.get(self.META_TAKE_LABELS)),
+                                   bool(meta.get(self.META_AUTO_WIRING))))
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    # -- introspection delegates to the ends of the chain -------------
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._stages[0].module.data_names if self._stages else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._stages[-1].module.output_names if self._stages else []
 
     @property
     def data_shapes(self):
         assert self.binded
-        return self._modules[0].data_shapes
+        return self._stages[0].module.data_shapes
 
     @property
     def label_shapes(self):
@@ -71,101 +74,86 @@ class SequentialModule(BaseModule):
     @property
     def output_shapes(self):
         assert self.binded
-        return self._modules[-1].output_shapes
+        return self._stages[-1].module.output_shapes
 
+    # -- parameters ---------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
-        for module in self._modules:
-            arg, aux = module.get_params()
-            arg_params.update(arg)
-            aux_params.update(aux)
-        return (arg_params, aux_params)
+        args, auxs = {}, {}
+        for stage in self._stages:
+            a, x = stage.module.get_params()
+            args.update(a)
+            auxs.update(x)
+        return args, auxs
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False):
         if self.params_initialized and not force_init:
             return
-        assert self.binded, "call bind before initializing the parameters"
-        from ..initializer import Uniform
+        assert self.binded, "bind the chain before init_params"
         if initializer is None:
+            from ..initializer import Uniform
             initializer = Uniform(0.01)
-        for module in self._modules:
-            module.init_params(initializer=initializer, arg_params=arg_params,
-                               aux_params=aux_params,
-                               allow_missing=allow_missing,
-                               force_init=force_init)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, "Duplicated parameter names: " \
-                    "name \"%s\" in layer %d (%s) is already used in layer %d " \
-                    "(%s)." % (name, i, type(modules[i]),
-                               known_names[name], type(modules[known_names[name]]))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        owner = {}
+        for idx, stage in enumerate(self._stages):
+            stage.module.init_params(initializer=initializer,
+                                     arg_params=arg_params,
+                                     aux_params=aux_params,
+                                     allow_missing=allow_missing,
+                                     force_init=force_init)
+            a, x = stage.module.get_params()
+            for name in list(a) + list(x):
+                if name in owner:
+                    raise ValueError(
+                        "parameter %r defined by both stage %d (%s) and "
+                        "stage %d (%s)" % (name, owner[name],
+                                           type(self._stages[owner[name]]
+                                                .module).__name__,
+                                           idx, type(stage.module).__name__))
+                owner[name] = idx
         self.params_initialized = True
 
+    # -- bind: thread shapes through the chain ------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        """Bind all modules in order (reference
-        ``sequential_module.py:153``)."""
+        """Bind every stage in order; each stage's data is the previous
+        stage's outputs (reference ``sequential_module.py:153``)."""
         if self.binded and not force_rebind:
-            self.logger.warning("Already binded, ignoring bind()")
+            self.logger.warning("SequentialModule already bound; skipping")
             return
+        if shared_module is not None:
+            raise ValueError("shared_module is not supported on chains")
+        if not self._stages:
+            raise ValueError("cannot bind an empty SequentialModule")
         if inputs_need_grad:
             assert for_training
-        assert shared_module is None, "Shared module is not supported"
-        assert len(self._modules) > 0, "Attempting to bind an empty SequentialModule"
 
-        self.binded = True
+        feed = data_shapes
+        for idx, stage in enumerate(self._stages):
+            if stage.auto_wiring:
+                names = stage.module.data_names
+                assert len(names) == len(feed)
+                feed = [DataDesc(n, (d.shape if isinstance(d, DataDesc)
+                                     else d[1]))
+                        for n, d in zip(names, feed)]
+            stage.module.bind(
+                data_shapes=feed,
+                label_shapes=label_shapes if stage.take_labels else None,
+                for_training=for_training,
+                # interior stages always need input grads to continue the
+                # backward chain
+                inputs_need_grad=bool(inputs_need_grad or
+                                      (for_training and idx > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            feed = [DataDesc(n, s) for n, s in stage.module.output_shapes]
+
+        any_labels = any(s.take_labels for s in self._stages)
+        self._label_shapes = label_shapes if any_labels else None
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
-        self._label_shapes = label_shapes
-
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
-
-            my_inputs_need_grad = bool(inputs_need_grad or
-                                       (for_training and i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [DataDesc(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names,
-                                         [(d.name, d.shape) if isinstance(d, DataDesc)
-                                          else d for d in my_data_shapes])]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-
-            # the output of the previous module is the data of the next one
-            my_data_shapes = [DataDesc(name, shape) for (name, shape)
-                              in module.output_shapes]
-
-        if not anybody_ever_needs_label:
-            self._label_shapes = None
+        self.binded = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
@@ -174,59 +162,56 @@ class SequentialModule(BaseModule):
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring.")
             return
-        for module in self._modules:
-            module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
-                                  optimizer_params=optimizer_params,
-                                  force_init=force_init)
+        for stage in self._stages:
+            stage.module.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                                        optimizer_params=optimizer_params,
+                                        force_init=force_init)
         self.optimizer_initialized = True
 
+    # -- execution ----------------------------------------------------
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        batch = DataBatch(data=data_batch.data, label=data_batch.label,
-                          pad=data_batch.pad, index=data_batch.index)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = data_batch
+        for idx, stage in enumerate(self._stages):
+            stage.module.forward(batch, is_train=is_train)
+            nxt = idx + 1
+            if nxt == len(self._stages):
                 break
-            batch = DataBatch(data=module.get_outputs(),
-                              label=data_batch.label
-                              if SequentialModule.META_TAKE_LABELS in
-                              self._metas[i_layer + 1] else None,
-                              pad=data_batch.pad, index=data_batch.index)
+            batch = DataBatch(
+                data=stage.module.get_outputs(),
+                label=(data_batch.label
+                       if self._stages[nxt].take_labels else None),
+                pad=data_batch.pad, index=data_batch.index)
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)),
-                                                 self._modules))):
-            module.backward(out_grads=out_grads)
-            if i_layer == 0:
-                break
-            out_grads = module.get_input_grads()
+        for idx in range(len(self._stages) - 1, -1, -1):
+            self._stages[idx].module.backward(out_grads=out_grads)
+            if idx:
+                out_grads = self._stages[idx].module.get_input_grads()
 
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
-        for module in self._modules:
-            module.update()
+        assert self.optimizer_initialized
+        for stage in self._stages:
+            stage.module.update()
 
     def get_outputs(self, merge_multi_context=True):
         assert self.binded and self.params_initialized
-        return self._modules[-1].get_outputs(
+        return self._stages[-1].module.get_outputs(
             merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
-        return self._modules[0].get_input_grads(
+        assert self.binded and self.inputs_need_grad
+        return self._stages[0].module.get_input_grads(
             merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                module.update_metric(eval_metric, labels)
+        for stage in self._stages:
+            if stage.take_labels:
+                stage.module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):
         assert self.binded
-        for module in self._modules:
-            module.install_monitor(mon)
+        for stage in self._stages:
+            stage.module.install_monitor(mon)

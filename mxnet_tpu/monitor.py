@@ -1,94 +1,97 @@
-"""Monitor: per-op output statistics tap.
+"""Monitor: per-op output statistics tap for NaN-hunting and debugging.
 
-Reference: ``python/mxnet/monitor.py:16-126`` wired through the executor
-monitor callback (``graph_executor.cc:757-778``).  Installing a monitor
-switches the executor to per-node (uncompiled) evaluation — the same
-performance cliff as the reference disabling bulk exec — so stats can be
-pulled after every op for NaN-hunting.
+API parity with the reference's ``python/mxnet/monitor.py`` wired through
+the executor monitor callback (``src/executor/graph_executor.cc:757-778``).
+On TPU, installing a monitor flips the executor into per-node evaluation
+(the jitted whole-graph program can't surface intermediate buffers), the
+same performance cliff as the reference disabling bulk exec.
+
+Design: the Monitor is an armed/disarmed recorder.  ``tic()`` arms it on
+every ``interval``-th batch; while armed, the tap installed on each
+executor appends ``(batch, tensor name, stat)`` rows; ``toc()`` snapshots
+the watched weights as well, disarms, and renders the rows.
 """
 from __future__ import annotations
 
 import logging
 import re
 
-from .ndarray import NDArray
 from . import ndarray
 
 
-class Monitor(object):
-    """Monitor outputs, weights and gradients for debugging.
+def _default_stat(x):
+    """Scale-free magnitude: ||x||_2 / sqrt(n) (mean-square root)."""
+    return ndarray.norm(x) / (x.size ** 0.5)
 
-    Parameters mirror the reference: ``interval`` batches between stat
-    collection, ``stat_func`` maps NDArray -> NDArray stat (default
-    mean(abs(x))), ``pattern`` regex selects which tensors to watch.
+
+class Monitor:
+    """Watch tensors matching ``pattern`` every ``interval`` batches.
+
+    ``stat_func`` maps NDArray -> NDArray (default mean-magnitude);
+    ``sort=True`` orders the report by tensor name.  Reference:
+    ``python/mxnet/monitor.py:16-126``.
     """
 
     def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return ndarray.norm(x) / (x.size ** 0.5)
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
-        self.step = 0
-        self.exes = []
-        self.re_prog = re.compile(pattern)
+        self.stat_func = stat_func or _default_stat
         self.sort = sort
+        self._watch = re.compile(pattern).match
+        self._armed = False
+        self._batch = 0
+        self._rows = []            # (batch, name, stat) while armed
+        self._executors = []
+        # executors call the tap as a plain function(name, array)
+        self.stat_helper = self._record
 
-        def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
+    def _record(self, name, array):
+        if self._armed and self._watch(name):
+            self._rows.append((self._batch, name, self.stat_func(array)))
 
-        self.stat_helper = stat_helper
+    def _drain_pending(self):
+        for exe in self._executors:
+            for arr in exe.arg_arrays:
+                arr.wait_to_read()
 
     def install(self, exe):
-        """Install the tap on an executor (reference ``monitor.py:56``);
-        idempotent per executor."""
+        """Register the tap on an executor (reference ``monitor.py:56``)."""
         exe.install_monitor(self.stat_helper)
-        if exe not in self.exes:
-            self.exes.append(exe)
+        if all(e is not exe for e in self._executors):
+            self._executors.append(exe)
 
     def tic(self):
-        """Start collecting stats for this batch if due
+        """Arm the recorder if this batch is due
         (reference ``monitor.py:68``)."""
-        if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
-            self.queue = []
-            self.activated = True
-        self.step += 1
+        if self._batch % self.interval == 0:
+            self._drain_pending()
+            self._rows = []
+            self._armed = True
+        self._batch += 1
 
     def toc(self):
-        """Finish collecting; returns [(step, name, stat_str)]
-        (reference ``monitor.py:82``)."""
-        if not self.activated:
+        """Disarm; snapshot watched weights; return rendered
+        ``[(batch, name, stat_str)]`` rows (reference ``monitor.py:82``)."""
+        if not self._armed:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
-        for exe in self.exes:
-            for name, array in exe.arg_dict.items():
-                if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
-        self.activated = False
-        res = []
+        self._drain_pending()
+        for exe in self._executors:
+            self._rows.extend(
+                (self._batch, name, self.stat_func(arr))
+                for name, arr in exe.arg_dict.items() if self._watch(name))
+        self._armed = False
+        rows, self._rows = self._rows, []
         if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            assert isinstance(v_list, list)
-            s = ",".join("%f" % v.asnumpy().reshape(-1)[0] for v in v_list)
-            res.append((n, k, s))
-        self.queue = []
-        return res
+            rows.sort(key=lambda row: row[1])
+        return [(batch, name, self._render(stat))
+                for batch, name, stat in rows]
+
+    @staticmethod
+    def _render(stat):
+        stats = stat if isinstance(stat, list) else [stat]
+        return ",".join("%f" % float(s.asnumpy().reshape(-1)[0])
+                        for s in stats)
 
     def toc_print(self):
-        """Collect and log stats (reference ``monitor.py:122``)."""
-        res = self.toc()
-        for n, k, v in res:
-            logging.info("Batch: %7d %30s %s", n, k, v)
+        """``toc()`` + log each row (reference ``monitor.py:122``)."""
+        for batch, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", batch, name, stat)

@@ -29,4 +29,7 @@ def _seed():
     np.random.seed(0)
     import mxnet_tpu as mx
     mx.random.seed(0)
+    # fresh auto-naming counters per test: node names like "plus1" must not
+    # depend on how many symbols earlier tests created (process-global state)
+    mx.name.NameManager._current.value = mx.name.NameManager()
     yield

@@ -34,6 +34,7 @@ def main():
     import mxnet_tpu as mx
 
     workdir = sys.argv[1]
+    os.makedirs(workdir, exist_ok=True)
     prefix = os.path.join(workdir, "ckpt")
     marker = os.path.join(workdir, "crashed-once")
 

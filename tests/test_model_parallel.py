@@ -41,9 +41,11 @@ def test_chain_placed_matches_unplaced():
     np.testing.assert_allclose(out1, out2, rtol=1e-6)
     for k in g1:
         np.testing.assert_allclose(g1[k], g2[k], rtol=1e-6)
-    # the placement is real: nodes carry their mapped device
+    # the placement is real: nodes carry their mapped device (structural
+    # check — do not assert on auto-generated node names, they depend on
+    # process-global NameManager counters)
     dbg = ex1.debug_str()
-    assert "Device=" in dbg and "plus1" in dbg
+    assert "Device=" in dbg
     placed = ex1._prog.placement
     assert len({str(d) for d in placed.values()}) == 2
 
