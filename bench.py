@@ -21,6 +21,85 @@ import numpy as np
 BASELINE_IMG_S = 181.53  # reference single-P100 ResNet-50 train, batch 32
 
 
+def _pipeline_bench(mx, mod, metric, n_images=300, batch=256, steps=3):
+    """Feed the already-compiled train step from the real input pipeline:
+    RecordIO -> native C++ JPEG decode pool -> PrefetchingIter (engine
+    double-buffering) -> H2D -> fused step.  Returns JSON fields for the
+    bench line, including the measured host caps that bound it on this
+    driver host."""
+    import jax
+    import numpy as np
+    from mxnet_tpu import io, recordio
+    from mxnet_tpu.io import NativeImageRecordIter, PrefetchingIter
+
+    rec_path = "/tmp/mxtpu_bench_%d.rec" % n_images
+    if not os.path.exists(rec_path):
+        from PIL import Image
+        import io as pio
+        rng = np.random.RandomState(0)
+        tmp_path = rec_path + ".tmp.%d" % os.getpid()
+        rec = recordio.MXRecordIO(tmp_path, "w")
+        for i in range(n_images):
+            img = Image.fromarray(
+                rng.randint(0, 255, (256, 256, 3), dtype=np.uint8))
+            buf = pio.BytesIO()
+            img.save(buf, format="JPEG", quality=90)
+            rec.write(recordio.pack(
+                recordio.IRHeader(0, float(i % 1000), i, 0), buf.getvalue()))
+        rec.close()
+        os.rename(tmp_path, rec_path)   # atomic: no truncated cache reuse
+
+    # measured host->device cap (the binding constraint through the
+    # tunnel): time one mid-size transfer; warm both the transfer AND the
+    # jnp.sum completion barrier so compile time stays out of the window
+    probe = np.zeros((16, 224, 224, 3), np.float32)
+    float(jax.numpy.sum(jax.device_put(probe)))
+    t0 = time.perf_counter()
+    d = jax.device_put(probe)
+    float(jax.numpy.sum(d))
+    h2d_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+
+    it = NativeImageRecordIter(
+        path_imgrec=rec_path, data_shape=(3, 224, 224), batch_size=batch,
+        rand_crop=True, rand_mirror=True,
+        preprocess_threads=max(2, os.cpu_count() or 1))
+    it = PrefetchingIter(it)
+
+    def batches():
+        while True:
+            for b in it:
+                # loader emits CHW; the NHWC model wants channels-last
+                x = np.ascontiguousarray(
+                    b.data[0].asnumpy().transpose(0, 2, 3, 1))
+                yield io.DataBatch(data=[mx.nd.array(x)], label=b.label,
+                                   pad=b.pad)
+            it.reset()
+
+    gen = batches()
+    b = next(gen)                       # warmup: same compiled program
+    mod.forward(b, is_train=True)
+    mod.update()
+    mod.update_metric(metric, b.label)
+    metric.get()
+    metric.reset()
+
+    t0 = time.perf_counter()
+    fresh = 0
+    for _ in range(steps):
+        b = next(gen)
+        fresh += batch - b.pad         # count only real (decoded) images
+        mod.forward(b, is_train=True)
+        mod.update()
+        mod.update_metric(metric, b.label)
+    metric.get()
+    elapsed = time.perf_counter() - t0
+    return {
+        "pipeline_img_per_sec": round(fresh / elapsed, 2),
+        "pipeline_host_h2d_mbps": round(h2d_mbps, 1),
+        "pipeline_host_cpu_cores": os.cpu_count(),
+    }
+
+
 def main():
     # fuse the Module step on every backend (the default for tpu contexts)
     os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
@@ -102,6 +181,22 @@ def main():
     # MFU vs the measured chip peak (tools/roofline.py artifact): step
     # flops from XLA's own cost analysis over the same accounting that
     # measured the peak
+    # --- end-to-end input pipeline (the reference's real-data-vs-
+    # --benchmark-1 parity contract, fit.py) ------------------------------
+    # Feed the same model through NativeImageRecordIter (C++ libjpeg
+    # thread-pool decode) + PrefetchingIter (engine double-buffering) over
+    # a synthetic RecordIO file.  On this driver host the pipeline is
+    # environment-bound, not framework-bound: ONE cpu core (JPEG decode
+    # ~400 img/s max) and ~10-40 MB/s host->device through the tunnel
+    # (tens of img/s at f32 224^2 batches; measured below and reported in
+    # the JSON line).  tests/test_io.py::test_prefetch_overlap proves the
+    # producer/consumer overlap property itself.
+    pipe = None
+    if on_tpu:
+        try:
+            pipe = _pipeline_bench(mx, mod, metric)
+        except Exception as e:                      # noqa: BLE001
+            print("pipeline bench failed: %s" % e, file=sys.stderr)
     try:
         roof = json.load(open(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "ROOFLINE.json")))
@@ -120,6 +215,8 @@ def main():
             step_tflops / roof["bf16_matmul_tflops"], 3)
     except Exception:
         pass
+    if pipe is not None:
+        line.update(pipe)
     print(json.dumps(line))
 
 
