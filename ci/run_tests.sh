@@ -20,6 +20,8 @@ python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_sync_kvstore.py
 python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_mlp.py
+python tools/launch.py -n 2 --launcher local -- \
+    python tests/nightly/dist_fused_mlp.py
 
 echo "=== crash-restart recovery (auto-restart orchestration) ==="
 RESUME_DIR="$(mktemp -d)"

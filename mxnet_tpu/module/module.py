@@ -329,6 +329,29 @@ class Module(BaseModule):
             n -= 1
         return make_mesh({"data": n}, devs[:n])
 
+    def _auto_global_mesh(self):
+        """Widen the auto mesh to all processes' devices for multi-host
+        fused training.  Picks the largest per-process device count k
+        that divides the local batch (k=1 always qualifies, so with >1
+        process this succeeds); returns None only when there is just one
+        process — the caller then falls back to the classic executor
+        path so cross-host sync is never silently skipped."""
+        import jax
+        from ..parallel import make_mesh
+        local_batch = self._data_shapes[0].shape[0]
+        per_proc = {}
+        for d in jax.devices():
+            per_proc.setdefault(d.process_index, []).append(d)
+        k = min(len(v) for v in per_proc.values())
+        while k > 1 and local_batch % k != 0:
+            k -= 1
+        devs = []
+        for p in sorted(per_proc):
+            devs.extend(sorted(per_proc[p], key=lambda d: d.id)[:k])
+        if len(devs) <= k:      # single process after all
+            return None
+        return make_mesh({"data": len(devs)}, devs)
+
     def _build_param_mirrors(self):
         shapes = {d.name: d.shape for d in self._data_shapes}
         if self._label_shapes:
@@ -390,20 +413,30 @@ class Module(BaseModule):
                 kv = _kv_create(kvstore)
             else:
                 kv = None
-            multihost_auto = (kv is not None and "dist" in kv.type and
-                              kv.num_workers > 1 and self._auto_fused)
-            if multihost_auto or not _supports_fusion(optimizer):
-                # Fall back to the classic executor path when the fused
-                # step cannot represent this configuration: (a) multi-host
-                # with only an auto-built single-host mesh (the fused step
-                # would not sync across hosts; KVStoreTPU's psum does —
-                # pass an explicit global Mesh to fuse multi-host), or
-                # (b) an optimizer without a pure fused-step rule (SGLD,
-                # user-defined subclasses).
-                if not multihost_auto:
-                    self.logger.warning(
-                        "optimizer %s has no fused-step rule; using the "
-                        "classic executor path", type(optimizer).__name__)
+            fallback = None
+            if (kv is not None and "dist" in kv.type and
+                    kv.num_workers > 1 and self._auto_fused):
+                # multi-host with an auto-built single-host mesh: widen it
+                # to the GLOBAL mesh over every process's devices, so the
+                # cross-host gradient psum compiles into the fused step
+                # (the reference's dist_sync exactness via allreduce,
+                # kvstore_dist_server.h:164-210, now at ICI/DCN speed)
+                gmesh = self._auto_global_mesh()
+                if gmesh is not None:
+                    self._mesh = gmesh
+                else:
+                    # never train multi-host on a local-only fused step:
+                    # it would silently skip cross-host gradient sync
+                    fallback = ("could not build a global mesh; using the "
+                                "classic executor path with kvstore sync")
+            if fallback is None and not _supports_fusion(optimizer):
+                # optimizer without a pure fused-step rule (SGLD,
+                # user-defined subclasses)
+                fallback = ("optimizer %s has no fused-step rule; using "
+                            "the classic executor path"
+                            % type(optimizer).__name__)
+            if fallback is not None:
+                self.logger.warning(fallback)
                 self._mesh = None
                 self._trainer = None
                 self._bind_exec_group()

@@ -65,6 +65,15 @@ class Trainer:
         self.label_names = [n for n in label_names
                             if n in self.prog.arg_names]
         self.mesh = mesh
+        # multi-host mesh: some devices belong to other processes.  The
+        # caller binds LOCAL batch shapes; the compiled program sees the
+        # GLOBAL batch, each process contributing its shard
+        # (make_array_from_process_local_data), and reads back only its
+        # addressable output rows — the jax.distributed analog of the
+        # reference's per-worker DataBatch under dist_sync.
+        self.multihost = mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat)
         self.compute_dtype = _dtype(compute_dtype) if compute_dtype else None
         self.param_specs = param_specs or {}
         input_set = set(self.data_names) | set(self.label_names)
@@ -86,6 +95,12 @@ class Trainer:
              label_shapes: Optional[Dict[str, tuple]] = None):
         shapes = dict(data_shapes)
         shapes.update(label_shapes or {})
+        if self.multihost:
+            # caller passed per-process (local) batch shapes; the program
+            # is traced at the global batch
+            scale = jax.process_count()
+            shapes = {n: (s[0] * scale,) + tuple(s[1:])
+                      for n, s in shapes.items()}
         arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
         if arg_shapes is None:
             raise MXNetError("cannot infer shapes from %s" % shapes)
@@ -136,7 +151,28 @@ class Trainer:
     def _place(self, value, sharding):
         if sharding is None:
             return value
+        if self.multihost:
+            # each process contributes its addressable part (for a
+            # replicated sharding: the full identical array)
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(value))
         return jax.device_put(value, sharding)
+
+    def _local_rows(self, out):
+        """This process's rows of a batch-sharded global output (already
+        whole on single-host)."""
+        if not self.multihost:
+            return out
+        shards = {}
+        for s in out.addressable_shards:
+            start = s.index[0].start or 0 if s.index else 0
+            shards[start] = s.data
+        parts = [shards[k] for k in sorted(shards)]
+        if len(parts) == 1:
+            return jnp.asarray(parts[0])
+        # shards live on different local devices; assemble host-side
+        # (outputs are small: batch rows x classes)
+        return jnp.asarray(np.concatenate([np.asarray(p) for p in parts], 0))
 
     # ------------------------------------------------------------------
     def _build(self):
@@ -229,6 +265,11 @@ class Trainer:
         out = {}
         for n in self._input_shapes:
             v = batch[n]
+            if self.multihost:
+                v = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+                out[n] = jax.make_array_from_process_local_data(
+                    self._batch_shardings[n], v)
+                continue
             if isinstance(v, NDArray):
                 v = v.data
             else:
@@ -258,13 +299,13 @@ class Trainer:
         self.params, self.aux, self.opt_state, outs = self._step_fn(
             self.params, self.aux, self.opt_state, dev_batch,
             self._lr_cache[1], jnp.int32(max(1, self.num_update)), key)
-        return [NDArray(o) for o in outs]
+        return [NDArray(self._local_rows(o)) for o in outs]
 
     def forward(self, batch: Dict) -> List[NDArray]:
         """Inference forward (is_train=False) as one compiled program."""
         dev_batch = self._device_batch(batch)
         outs = self._eval_fn(self.params, self.aux, dev_batch, self._key)
-        return [NDArray(o) for o in outs]
+        return [NDArray(self._local_rows(o)) for o in outs]
 
     def forward_train(self, batch: Dict) -> List[NDArray]:
         """Training-mode forward WITHOUT the update — for callers that
@@ -274,13 +315,13 @@ class Trainer:
         dev_batch = self._device_batch(batch)
         outs = self._eval_train_fn(self.params, self.aux, dev_batch,
                                    self._key)
-        return [NDArray(o) for o in outs]
+        return [NDArray(self._local_rows(o)) for o in outs]
 
     def get_opt_states(self) -> bytes:
         """Serialize (num_update, optimizer state pytree) — the fused
         analog of ``Updater.get_states`` (reference ``optimizer.py``)."""
         import pickle
-        state = jax.tree.map(np.asarray, self.opt_state)
+        state = jax.tree.map(self._host_value, self.opt_state)
         return pickle.dumps((self.num_update, state))
 
     def set_opt_states(self, blob: bytes) -> None:
@@ -290,11 +331,29 @@ class Trainer:
         self.optimizer.num_update = num_update
         cur = self.opt_state
         self.opt_state = jax.tree.map(
-            lambda c, n: jax.device_put(jnp.asarray(n), c.sharding)
-            if hasattr(c, "sharding") else jnp.asarray(n), cur, state)
+            lambda c, n: self._place(jnp.asarray(n), getattr(
+                c, "sharding", None)), cur, state)
 
     # ------------------------------------------------------------------
+    def _host_value(self, v):
+        """Global host copy of a (possibly multi-host) device array.
+        Replicated leaves read the local replica; sharded leaves
+        all-gather — a COLLECTIVE, so on multi-host every process must
+        call checkpoint reads in lockstep (as ``Module.fit`` does)."""
+        if not self.multihost:
+            return np.asarray(v)
+        if getattr(v, "is_fully_replicated", True):
+            return np.asarray(v.addressable_data(0))
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(v, tiled=True))
+
     def get_params(self):
+        if self.multihost:
+            arg = {n: NDArray(jnp.asarray(self._host_value(v)))
+                   for n, v in self.params.items()}
+            aux = {n: NDArray(jnp.asarray(self._host_value(v)))
+                   for n, v in self.aux.items()}
+            return arg, aux
         arg = {n: NDArray(v) for n, v in self.params.items()}
         aux = {n: NDArray(v) for n, v in self.aux.items()}
         return arg, aux
