@@ -24,10 +24,12 @@ python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_fused_mlp.py
 
 echo "=== crash-restart recovery (auto-restart orchestration) ==="
+# heartbeats over the jax.distributed coordination service (no shared
+# filesystem; the file transport is unit-tested in test_health.py)
 RESUME_DIR="$(mktemp -d)"
 trap 'rm -rf "$RESUME_DIR"' EXIT
-python tools/launch.py -n 2 --launcher local --auto-restart 1 -- \
-    python tests/nightly/dist_resume.py "$RESUME_DIR"
+MXTPU_HEARTBEAT_TRANSPORT=kv python tools/launch.py -n 2 --launcher local \
+    --auto-restart 1 -- python tests/nightly/dist_resume.py "$RESUME_DIR"
 
 echo "=== cpu-vs-tpu consistency ==="
 python tests/nightly/consistency.py

@@ -5,11 +5,21 @@ The reference surfaces worker/server liveness through ps-lite heartbeats
 ``src/kvstore/kvstore_dist.h:157-166``) and restart-aware barriers
 (``is_recovery``, ``kvstore_dist.h:39-44``).  The TPU build has no server
 role and XLA collectives are fail-stop, so recovery = detect + restart +
-reload checkpoint (SURVEY §5).  This module provides the detection half:
-each worker's :class:`Heartbeat` thread stamps ``hb-<rank>`` in a shared
-directory (set by the launcher via ``MXTPU_HEARTBEAT_DIR``); any worker
-can ask which ranks have gone stale.  ``tools/launch.py --auto-restart``
-provides the restart half.
+reload checkpoint (SURVEY §5).  This module provides the detection half;
+``tools/launch.py --auto-restart`` provides the restart half.
+
+Two stamp transports, chosen per call:
+
+* **coordination-service KV** (default when ``jax.distributed`` is
+  initialized): stamps ride the same network channel the job already
+  depends on — works across hosts with no shared filesystem, like the
+  reference's ps-lite heartbeats rode its own TCP connections.
+* **shared directory** (``MXTPU_HEARTBEAT_DIR``, set by the local
+  launcher): survives coordination-service death, used by the
+  single-host restart orchestration and the unit tests.
+
+Both are scanned by :func:`dead_nodes`; a rank is alive if EITHER stamp
+is fresh, so mixed configurations never produce false positives.
 """
 from __future__ import annotations
 
@@ -21,6 +31,7 @@ from typing import List, Optional
 __all__ = ["Heartbeat", "dead_nodes", "heartbeat_dir"]
 
 _DEFAULT_INTERVAL = 1.0
+_KV_PREFIX = "mxtpu/hb/"
 
 
 def heartbeat_dir() -> Optional[str]:
@@ -31,18 +42,30 @@ def _stamp_path(directory: str, rank: int) -> str:
     return os.path.join(directory, "hb-%d" % rank)
 
 
+def _kv_client():
+    """The jax.distributed coordination-service client, if this process
+    has joined one (None otherwise)."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client
+    except Exception:
+        return None
+
+
 class Heartbeat:
-    """Background stamper for one worker's liveness file."""
+    """Background stamper for one worker's liveness."""
 
     def __init__(self, rank: int, directory: Optional[str] = None,
                  interval: float = _DEFAULT_INTERVAL):
         self.rank = rank
         self.directory = directory or heartbeat_dir()
+        self._kv = _kv_client()
         self.interval = interval
         self._stop = threading.Event()
         self._thread = None
         if self.directory:
             os.makedirs(self.directory, exist_ok=True)
+        if self.directory or self._kv is not None:
             self._beat()
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
@@ -52,16 +75,20 @@ class Heartbeat:
         return self._thread is not None
 
     def _beat(self):
-        path = _stamp_path(self.directory, self.rank)
-        with open(path, "w") as f:
-            f.write("%f\n" % time.time())
+        stamp = "%f" % time.time()
+        if self.directory:
+            with open(_stamp_path(self.directory, self.rank), "w") as f:
+                f.write(stamp + "\n")
+        if self._kv is not None:
+            self._kv.key_value_set(_KV_PREFIX + str(self.rank), stamp,
+                                   allow_overwrite=True)
 
     def _run(self):
         while not self._stop.wait(self.interval):
             try:
                 self._beat()
-            except OSError:
-                pass
+            except Exception:      # noqa: BLE001 — OSError or a dead
+                pass               # coordination service; keep trying
 
     def stop(self):
         self._stop.set()
@@ -70,21 +97,49 @@ class Heartbeat:
             self._thread = None
 
 
+def _file_stamps(directory: str, num_workers: int) -> dict:
+    out = {}
+    for rank in range(num_workers):
+        try:
+            out[rank] = os.path.getmtime(_stamp_path(directory, rank))
+        except OSError:
+            pass
+    return out
+
+
+def _kv_stamps(client) -> dict:
+    out = {}
+    try:
+        rows = client.key_value_dir_get(_KV_PREFIX)
+    except Exception:              # noqa: BLE001 — service down/empty
+        return out
+    for key, value in rows:
+        try:
+            out[int(key.rsplit("/", 1)[-1])] = float(value)
+        except ValueError:
+            pass
+    return out
+
+
 def dead_nodes(num_workers: int, timeout: float = 60.0,
                directory: Optional[str] = None) -> List[int]:
-    """Ranks whose heartbeat is missing or older than ``timeout`` seconds
-    (the ``get_num_dead_node`` scan).  Empty when heartbeats are not
+    """Ranks with no fresh stamp on any transport within ``timeout``
+    seconds (the ``get_num_dead_node`` scan).  Empty when no transport is
     configured — matching the reference's single-process behavior."""
     directory = directory or heartbeat_dir()
-    if not directory or not os.path.isdir(directory):
+    client = _kv_client()
+    stamps = _kv_stamps(client) if client is not None else {}
+    kv_active = bool(stamps)        # kv transport is in use iff stamped
+    dir_active = bool(directory) and os.path.isdir(directory)
+    if dir_active:
+        for rank, ts in _file_stamps(directory, num_workers).items():
+            stamps[rank] = max(stamps.get(rank, 0.0), ts)
+    if not kv_active and not dir_active:
+        # no transport in active use (dir unset/removed, nobody stamped
+        # the kv store): report nothing dead, like the reference's
+        # single-process behavior — never declare a whole job dead on
+        # absence of configuration
         return []
     now = time.time()
-    dead = []
-    for rank in range(num_workers):
-        path = _stamp_path(directory, rank)
-        try:
-            if now - os.path.getmtime(path) > timeout:
-                dead.append(rank)
-        except OSError:
-            dead.append(rank)
-    return dead
+    return [rank for rank in range(num_workers)
+            if now - stamps.get(rank, 0.0) > timeout]

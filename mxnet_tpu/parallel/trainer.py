@@ -163,6 +163,16 @@ class Trainer:
         whole on single-host)."""
         if not self.multihost:
             return out
+        # fast path assumes sharding along dim 0 only; an output that
+        # came back sharded along a non-batch dim (e.g. tensor-parallel
+        # param_specs) must be assembled globally first
+        if any(any(sl != slice(None) and (sl.start, sl.stop) != (0, dim)
+                   for sl, dim in zip(s.index[1:], out.shape[1:]))
+               for s in out.addressable_shards):
+            full = self._host_value(out)
+            rows = out.shape[0] // jax.process_count()
+            p = jax.process_index()
+            return jnp.asarray(full[p * rows:(p + 1) * rows])
         shards = {}
         for s in out.addressable_shards:
             start = s.index[0].start or 0 if s.index else 0
@@ -330,9 +340,20 @@ class Trainer:
         self.num_update = num_update
         self.optimizer.num_update = num_update
         cur = self.opt_state
-        self.opt_state = jax.tree.map(
-            lambda c, n: self._place(jnp.asarray(n), getattr(
-                c, "sharding", None)), cur, state)
+
+        def _restore(c, n):
+            sharding = getattr(c, "sharding", None)
+            if sharding is None:
+                return jnp.asarray(n)
+            if self.multihost:
+                # n is the GLOBAL array (what get_opt_states saved);
+                # hand each device exactly its slice of it
+                n = np.asarray(n)
+                return jax.make_array_from_callback(
+                    n.shape, sharding, lambda idx: n[idx])
+            return jax.device_put(jnp.asarray(n), sharding)
+
+        self.opt_state = jax.tree.map(_restore, cur, state)
 
     # ------------------------------------------------------------------
     def _host_value(self, v):

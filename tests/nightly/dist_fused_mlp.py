@@ -100,8 +100,6 @@ def main():
 
     # (3) parity with a serial run over the same global batches: global
     # batch k is concat over ranks of each rank's k-th local batch
-    order = np.concatenate(
-        [np.arange(r, len(X), nworker) for r in range(nworker)])
     nb = len(Xs) // LOCAL_BATCH
     rows = np.concatenate([
         np.concatenate([np.arange(r, len(X), nworker)

@@ -65,9 +65,15 @@ def _run_local_once(args, allow_grace):
             # local mode runs on host CPU devices
             "JAX_PLATFORMS": "cpu",
             "TPU_SKIP_MDS_QUERY": "1",
-            # liveness stamps for KVStore.num_dead_node
-            "MXTPU_HEARTBEAT_DIR": hb_dir,
         })
+        if os.environ.get("MXTPU_HEARTBEAT_TRANSPORT", "dir") != "kv":
+            # file liveness stamps for KVStore.num_dead_node; with
+            # transport "kv" the stamps ride the jax.distributed
+            # coordination service instead (no shared filesystem needed —
+            # the multi-host default; health.py scans both)
+            env["MXTPU_HEARTBEAT_DIR"] = hb_dir
+        else:
+            env.pop("MXTPU_HEARTBEAT_DIR", None)
         if args.devices_per_worker:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
                                 " --xla_force_host_platform_device_count=%d"
