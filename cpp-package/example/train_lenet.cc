@@ -1,0 +1,165 @@
+// Train LeNet on MNIST entirely through the mxtpu C ABI — symbol
+// composition, MNISTIter data pipeline, SimpleBind executor,
+// forward/backward, and SGD updates, with no Python in the application
+// (the runtime underneath is the embedded interpreter + XLA).
+//
+// This is the reference's cpp-package training contract
+// (cpp-package/example/lenet.cpp in peide/mxnet): the C API
+// (include/mxnet/c_api.h) is the single choke point; if a C++ program
+// can train through it, every binding can.
+//
+// Usage: train_lenet <mnist-images> <mnist-labels> [epochs] [min_acc]
+
+#include <algorithm>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+#include <vector>
+
+#include "../include/mxtpu_cpp.hpp"
+
+using mxtpu::DataIter;
+using mxtpu::Executor;
+using mxtpu::KWArgs;
+using mxtpu::NDArray;
+using mxtpu::Shape;
+using mxtpu::Symbol;
+
+namespace {
+
+Symbol LeNet() {
+  Symbol data = Symbol::Variable("data");
+  Symbol c1 = Symbol::Op("Convolution",
+                         {{"kernel", "(5, 5)"}, {"num_filter", "8"}},
+                         {{"data", data}}, "conv1");
+  Symbol a1 = Symbol::Op("Activation", {{"act_type", "tanh"}},
+                         {{"data", c1}}, "tanh1");
+  Symbol p1 = Symbol::Op("Pooling",
+                         {{"pool_type", "max"}, {"kernel", "(2, 2)"},
+                          {"stride", "(2, 2)"}},
+                         {{"data", a1}}, "pool1");
+  Symbol c2 = Symbol::Op("Convolution",
+                         {{"kernel", "(5, 5)"}, {"num_filter", "16"}},
+                         {{"data", p1}}, "conv2");
+  Symbol a2 = Symbol::Op("Activation", {{"act_type", "tanh"}},
+                         {{"data", c2}}, "tanh2");
+  Symbol p2 = Symbol::Op("Pooling",
+                         {{"pool_type", "max"}, {"kernel", "(2, 2)"},
+                          {"stride", "(2, 2)"}},
+                         {{"data", a2}}, "pool2");
+  Symbol fl = Symbol::Op("Flatten", {}, {{"data", p2}}, "flatten");
+  Symbol f1 = Symbol::Op("FullyConnected", {{"num_hidden", "64"}},
+                         {{"data", fl}}, "fc1");
+  Symbol a3 = Symbol::Op("Activation", {{"act_type", "tanh"}},
+                         {{"data", f1}}, "tanh3");
+  Symbol f2 = Symbol::Op("FullyConnected", {{"num_hidden", "10"}},
+                         {{"data", a3}}, "fc2");
+  return Symbol::Op("SoftmaxOutput", {}, {{"data", f2}}, "softmax");
+}
+
+// simple deterministic uniform init (the C++ app owns initialization —
+// the reference's cpp examples used mx.init through callbacks; host-side
+// Xavier keeps this file Python-free)
+void XavierFill(std::vector<float> *w, const std::vector<unsigned> &shape,
+                unsigned *seed) {
+  size_t fan = shape.size() > 1 ? shape[1] : shape[0];
+  for (size_t i = 2; i < shape.size(); ++i) fan *= shape[i];
+  float scale = std::sqrt(3.0f / static_cast<float>(fan));
+  for (auto &v : *w) {
+    *seed = *seed * 1664525u + 1013904223u;
+    v = (static_cast<float>(*seed >> 8) /
+             static_cast<float>(1u << 24) * 2.0f - 1.0f) * scale;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 3) {
+    std::fprintf(stderr,
+                 "usage: %s <mnist-images> <mnist-labels> [epochs]\n",
+                 argv[0]);
+    return 2;
+  }
+  const std::string images = argv[1], labels = argv[2];
+  const int epochs = argc > 3 ? std::atoi(argv[3]) : 8;
+  const float min_acc = argc > 4 ? std::atof(argv[4]) : 0.0f;
+  const unsigned kBatch = 20;
+  const float lr = 0.05f;
+
+  try {
+    Symbol net = LeNet();
+
+    DataIter train("MNISTIter", KWArgs{{"image", images},
+                                       {"label", labels},
+                                       {"batch_size", "20"},
+                                       {"shuffle", "False"},
+                                       {"silent", "True"},
+                                       {"flat", "False"}});
+
+    Executor exec(net,
+                  {{"data", Shape{20, 1, 28, 28}},
+                   {"softmax_label", Shape{20}}},
+                  /*dev_type=*/6, /*dev_id=*/0);
+
+    // init every trainable arg host-side, upload once
+    unsigned seed = 7;
+    std::vector<std::string> params;
+    for (const std::string &name : net.ListArguments()) {
+      if (name == "data" || name == "softmax_label") continue;
+      params.push_back(name);
+      NDArray arg = exec.Arg(name);
+      std::vector<float> w(arg.Size(), 0.0f);
+      if (name.find("bias") == std::string::npos)
+        XavierFill(&w, arg.GetShape(), &seed);
+      arg.CopyFrom(w.data());
+    }
+
+    float acc = 0.0f;
+    for (int epoch = 0; epoch < epochs; ++epoch) {
+      train.BeforeFirst();
+      size_t correct = 0, total = 0;
+      while (train.Next()) {
+        int pad = train.Pad();
+        NDArray x = train.Data(), y = train.Label();
+        exec.Arg("data").CopyFrom(x.ToVector().data());
+        exec.Arg("softmax_label").CopyFrom(y.ToVector().data());
+        exec.Forward(true);
+        exec.Backward();
+
+        // SGD through the ABI: host-side update, upload back (the
+        // imperative sgd_update op is exercised by ops_example)
+        for (const std::string &name : params) {
+          NDArray w = exec.Arg(name), g = exec.Grad(name);
+          std::vector<float> wv = w.ToVector(), gv = g.ToVector();
+          for (size_t i = 0; i < wv.size(); ++i)
+            wv[i] -= lr / kBatch * gv[i];
+          w.CopyFrom(wv.data());
+        }
+
+        std::vector<float> probs = exec.Outputs()[0].ToVector();
+        std::vector<float> truth = y.ToVector();
+        for (unsigned b = 0; b + pad < kBatch; ++b) {
+          const float *row = probs.data() + b * 10;
+          int pred = static_cast<int>(
+              std::max_element(row, row + 10) - row);
+          correct += pred == static_cast<int>(truth[b]);
+          ++total;
+        }
+      }
+      acc = static_cast<float>(correct) / static_cast<float>(total);
+      std::printf("epoch %d train-accuracy %.3f\n", epoch, acc);
+    }
+    if (acc < min_acc) {
+      std::fprintf(stderr, "accuracy %.3f below required %.3f\n", acc,
+                   min_acc);
+      return 1;
+    }
+    std::printf("train lenet OK acc=%.3f\n", acc);
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

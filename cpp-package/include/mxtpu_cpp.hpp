@@ -30,6 +30,53 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
                              void **inputs, int *num_outputs,
                              void ***outputs, int num_params,
                              const char **keys, const char **vals);
+// Symbol / Executor
+int MXSymbolListAtomicSymbolCreators(unsigned *out_size, void ***out);
+int MXSymbolGetAtomicSymbolName(void *creator, const char **name);
+int MXSymbolCreateAtomicSymbol(void *creator, unsigned num_param,
+                               const char **keys, const char **vals,
+                               void **out);
+int MXSymbolCreateVariable(const char *name, void **out);
+int MXSymbolCompose(void *sym, const char *name, unsigned num_args,
+                    const char **keys, void **args);
+int MXSymbolListArguments(void *sym, unsigned *out_size,
+                          const char ***out_array);
+int MXSymbolFree(void *sym);
+int MXExecutorSimpleBind(void *sym, int dev_type, int dev_id,
+                         unsigned num_args, const char **arg_names,
+                         const unsigned *shape_indptr,
+                         const unsigned *shape_data, const char *grad_req,
+                         void **out);
+int MXExecutorGetArg(void *exec, const char *name, void **out);
+int MXExecutorGetGrad(void *exec, const char *name, void **out);
+int MXExecutorForward(void *exec, int is_train);
+int MXExecutorBackward(void *exec, unsigned len, void **head_grads);
+int MXExecutorOutputs(void *exec, unsigned *out_size, void ***out);
+int MXExecutorFree(void *exec);
+// DataIter
+int MXListDataIters(unsigned *out_size, void ***out_array);
+int MXDataIterGetIterInfo(void *creator, const char **name,
+                          const char **description, unsigned *num_args,
+                          const char ***arg_names, const char ***arg_types,
+                          const char ***arg_descs);
+int MXDataIterCreateIter(void *creator, unsigned num_param,
+                         const char **keys, const char **vals, void **out);
+int MXDataIterNext(void *handle, int *out);
+int MXDataIterBeforeFirst(void *handle);
+int MXDataIterGetData(void *handle, void **out);
+int MXDataIterGetLabel(void *handle, void **out);
+int MXDataIterGetPadNum(void *handle, int *pad);
+int MXDataIterFree(void *handle);
+// KVStore
+int MXKVStoreCreate(const char *type, void **out);
+int MXKVStoreInit(void *kv, unsigned num, const int *keys, void **vals);
+int MXKVStorePush(void *kv, unsigned num, const int *keys, void **vals,
+                  int priority);
+int MXKVStorePull(void *kv, unsigned num, const int *keys, void **vals,
+                  int priority);
+int MXKVStoreGetRank(void *kv, int *rank);
+int MXKVStoreGetGroupSize(void *kv, int *size);
+int MXKVStoreFree(void *kv);
 }
 
 namespace mxtpu {
@@ -143,6 +190,211 @@ inline std::vector<NDArray> Invoke(const std::string &op,
   for (int i = 0; i < n_out; ++i) result.emplace_back(outs[i]);
   return result;
 }
+
+// ---------------------------------------------------------------- Symbol
+class Symbol {
+ public:
+  Symbol() = default;
+  explicit Symbol(void *handle) : handle_(handle, Deleter) {}
+
+  static Symbol Variable(const std::string &name) {
+    void *h = nullptr;
+    Check(MXSymbolCreateVariable(name.c_str(), &h),
+          "MXSymbolCreateVariable");
+    return Symbol(h);
+  }
+
+  // one-shot atomic create + compose: the way every layer is built
+  static Symbol Op(const std::string &op, const KWArgs &params,
+                   const std::vector<std::pair<std::string, Symbol>> &inputs,
+                   const std::string &name = "") {
+    void *creator = Creator(op);
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    void *h = nullptr;
+    Check(MXSymbolCreateAtomicSymbol(
+              creator, static_cast<unsigned>(keys.size()), keys.data(),
+              vals.data(), &h),
+          op.c_str());
+    Symbol sym(h);
+    std::vector<const char *> arg_keys;
+    std::vector<void *> arg_vals;
+    for (const auto &in : inputs) {
+      arg_keys.push_back(in.first.c_str());
+      arg_vals.push_back(in.second.handle());
+    }
+    Check(MXSymbolCompose(h, name.empty() ? nullptr : name.c_str(),
+                          static_cast<unsigned>(arg_keys.size()),
+                          arg_keys.data(), arg_vals.data()),
+          "MXSymbolCompose");
+    sym.inputs_ = inputs;  // keep referenced symbols alive
+    return sym;
+  }
+
+  std::vector<std::string> ListArguments() const {
+    unsigned n = 0;
+    const char **strs = nullptr;
+    Check(MXSymbolListArguments(handle_.get(), &n, &strs),
+          "MXSymbolListArguments");
+    return std::vector<std::string>(strs, strs + n);
+  }
+
+  void *handle() const { return handle_.get(); }
+
+ private:
+  static void *Creator(const std::string &op) {
+    unsigned n = 0;
+    void **creators = nullptr;
+    Check(MXSymbolListAtomicSymbolCreators(&n, &creators),
+          "MXSymbolListAtomicSymbolCreators");
+    for (unsigned i = 0; i < n; ++i) {
+      const char *name = nullptr;
+      Check(MXSymbolGetAtomicSymbolName(creators[i], &name),
+            "MXSymbolGetAtomicSymbolName");
+      if (op == name) return creators[i];
+    }
+    throw std::runtime_error("no such operator: " + op);
+  }
+  static void Deleter(void *h) {
+    if (h != nullptr) MXSymbolFree(h);
+  }
+  std::shared_ptr<void> handle_;
+  std::vector<std::pair<std::string, Symbol>> inputs_;
+};
+
+// -------------------------------------------------------------- Executor
+class Executor {
+ public:
+  Executor(const Symbol &sym,
+           const std::vector<std::pair<std::string, Shape>> &shapes,
+           int dev_type = 6, int dev_id = 0,
+           const std::string &grad_req = "write")
+      : sym_(sym) {
+    std::vector<const char *> names;
+    std::vector<unsigned> indptr{0}, dims;
+    for (const auto &s : shapes) {
+      names.push_back(s.first.c_str());
+      for (int d : s.second.dims) dims.push_back(d);
+      indptr.push_back(static_cast<unsigned>(dims.size()));
+    }
+    void *h = nullptr;
+    Check(MXExecutorSimpleBind(sym.handle(), dev_type, dev_id,
+                               static_cast<unsigned>(names.size()),
+                               names.data(), indptr.data(), dims.data(),
+                               grad_req.c_str(), &h),
+          "MXExecutorSimpleBind");
+    handle_ = std::shared_ptr<void>(h, Deleter);
+  }
+
+  NDArray Arg(const std::string &name) const {
+    void *h = nullptr;
+    Check(MXExecutorGetArg(handle_.get(), name.c_str(), &h),
+          "MXExecutorGetArg");
+    return NDArray(h);
+  }
+
+  NDArray Grad(const std::string &name) const {
+    void *h = nullptr;
+    Check(MXExecutorGetGrad(handle_.get(), name.c_str(), &h),
+          "MXExecutorGetGrad");
+    return NDArray(h);
+  }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_.get(), is_train ? 1 : 0),
+          "MXExecutorForward");
+  }
+
+  void Backward() {
+    Check(MXExecutorBackward(handle_.get(), 0, nullptr),
+          "MXExecutorBackward");
+  }
+
+  std::vector<NDArray> Outputs() const {
+    unsigned n = 0;
+    void **outs = nullptr;
+    Check(MXExecutorOutputs(handle_.get(), &n, &outs),
+          "MXExecutorOutputs");
+    std::vector<NDArray> result;
+    for (unsigned i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  static void Deleter(void *h) {
+    if (h != nullptr) MXExecutorFree(h);
+  }
+  Symbol sym_;  // keep graph alive for the executor's lifetime
+  std::shared_ptr<void> handle_;
+};
+
+// -------------------------------------------------------------- DataIter
+class DataIter {
+ public:
+  DataIter(const std::string &name, const KWArgs &params) {
+    unsigned n = 0;
+    void **creators = nullptr;
+    Check(MXListDataIters(&n, &creators), "MXListDataIters");
+    void *creator = nullptr;
+    for (unsigned i = 0; i < n; ++i) {
+      const char *cname = nullptr;
+      Check(MXDataIterGetIterInfo(creators[i], &cname, nullptr, nullptr,
+                                  nullptr, nullptr, nullptr),
+            "MXDataIterGetIterInfo");
+      if (name == cname) creator = creators[i];
+    }
+    if (creator == nullptr)
+      throw std::runtime_error("no such data iterator: " + name);
+    std::vector<const char *> keys, vals;
+    for (const auto &kv : params) {
+      keys.push_back(kv.first.c_str());
+      vals.push_back(kv.second.c_str());
+    }
+    void *h = nullptr;
+    Check(MXDataIterCreateIter(creator,
+                               static_cast<unsigned>(keys.size()),
+                               keys.data(), vals.data(), &h),
+          "MXDataIterCreateIter");
+    handle_ = std::shared_ptr<void>(h, Deleter);
+  }
+
+  bool Next() {
+    int more = 0;
+    Check(MXDataIterNext(handle_.get(), &more), "MXDataIterNext");
+    return more != 0;
+  }
+
+  void BeforeFirst() {
+    Check(MXDataIterBeforeFirst(handle_.get()), "MXDataIterBeforeFirst");
+  }
+
+  NDArray Data() const {
+    void *h = nullptr;
+    Check(MXDataIterGetData(handle_.get(), &h), "MXDataIterGetData");
+    return NDArray(h);
+  }
+
+  NDArray Label() const {
+    void *h = nullptr;
+    Check(MXDataIterGetLabel(handle_.get(), &h), "MXDataIterGetLabel");
+    return NDArray(h);
+  }
+
+  int Pad() const {
+    int pad = 0;
+    Check(MXDataIterGetPadNum(handle_.get(), &pad), "MXDataIterGetPadNum");
+    return pad;
+  }
+
+ private:
+  static void Deleter(void *h) {
+    if (h != nullptr) MXDataIterFree(h);
+  }
+  std::shared_ptr<void> handle_;
+};
 
 }  // namespace mxtpu
 

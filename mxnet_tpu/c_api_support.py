@@ -186,6 +186,142 @@ def executor_outputs(executor):
 
 
 # ----------------------------------------------------------------------
+# DataIter — powers the MXDataIter* C group (reference
+# ``c_api.h:1108-1199``): create registered iterators from string
+# params, then drive next/data/label/pad through handles
+def _iter_registry():
+    from . import io
+    return {
+        "MNISTIter": io.MNISTIter,
+        "CSVIter": io.CSVIter,
+        "ImageRecordIter": io.ImageRecordIter,
+        "ImageDetRecordIter": io.ImageDetRecordIter,
+    }
+
+
+def io_list_iters():
+    return sorted(_iter_registry())
+
+
+def io_create_iter(name, keys, vals):
+    # params stay strings: each iterator parses its own kwargs
+    # (int()/_parse_bool()/_as_shape() — the dmlc::Parameter analog),
+    # so a digits-only filename is never mis-coerced to a number here
+    cls = _iter_registry()[name]
+    return cls(**dict(zip(keys, vals)))
+
+
+def io_iter_next(it):
+    """Advance; stash the batch on the handle (the C getters read it)."""
+    try:
+        it._c_batch = it.next()
+        return 1
+    except StopIteration:
+        it._c_batch = None
+        return 0
+
+
+def io_iter_reset(it):
+    it.reset()
+    return True
+
+
+def io_iter_data(it):
+    return it._c_batch.data[0]
+
+
+def io_iter_label(it):
+    return it._c_batch.label[0]
+
+
+def io_iter_pad(it):
+    return int(it._c_batch.pad or 0)
+
+
+# ----------------------------------------------------------------------
+# RecordIO (reference ``c_api.h:1408-1466``)
+def recio_writer_create(uri):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "w")
+
+
+def recio_reader_create(uri):
+    from .recordio import MXRecordIO
+    return MXRecordIO(uri, "r")
+
+
+def recio_write(rec, blob):
+    rec.write(blob)
+    return True
+
+
+def recio_tell(rec):
+    return int(rec.tell())
+
+
+def recio_read(rec):
+    """Bytes of the next record; None at end-of-stream (a zero-length
+    RECORD returns b'', which is distinct from EOF)."""
+    out = rec.read()
+    return None if out is None else bytes(out)
+
+
+def recio_seek(rec, pos):
+    rec.seek_to(int(pos))
+    return True
+
+
+def recio_close(rec):
+    rec.close()
+    return True
+
+
+# ----------------------------------------------------------------------
+# Autograd (reference ``c_api.h:539-558``)
+def ag_set_is_training(is_train):
+    from . import autograd
+    prev = autograd.is_recording()
+    autograd.set_recording(bool(is_train))
+    autograd.set_training(bool(is_train))
+    return int(prev)
+
+
+def ag_mark_variables(variables, reqs, gradients):
+    from . import autograd
+    req_names = {0: "null", 1: "write", 2: "write", 3: "add"}
+    autograd.mark_variables(list(variables),
+                            list(gradients),
+                            [req_names[int(r)] for r in reqs])
+    return True
+
+
+def ag_compute_gradient(outputs):
+    from . import autograd
+    autograd.backward(list(outputs))
+    return True
+
+
+# ----------------------------------------------------------------------
+# Profiler (reference ``c_api.h:183-194``)
+def prof_set_config(mode, filename):
+    from . import profiler
+    profiler.profiler_set_config(
+        mode="all" if int(mode) else "symbolic", filename=filename)
+    return True
+
+
+def prof_set_state(state):
+    from . import profiler
+    profiler.profiler_set_state("run" if int(state) else "stop")
+    return True
+
+
+def prof_dump():
+    from . import profiler
+    return profiler.dump_profile()
+
+
+# ----------------------------------------------------------------------
 # KVStore
 def kv_create(kind):
     return _kvstore.create(kind)

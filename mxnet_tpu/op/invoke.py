@@ -28,7 +28,14 @@ def invoke(op: Op, inputs: List["NDArray"], kwargs: Dict, out=None,
 
     aux_states = aux_states or []
     in_vals = [a.data for a in inputs] + [a.data for a in aux_states]
-    outs, aux_updates = op.apply(params, ctx, *in_vals)
+    from .. import profiler as _prof
+    if _prof.is_running() and _prof.mode() == "all":
+        # 'all' mode also records imperative dispatches (reference
+        # MXSetProfilerConfig mode=1 behavior)
+        with _prof.record_scope(op.name, "imperative"):
+            outs, aux_updates = op.apply(params, ctx, *in_vals)
+    else:
+        outs, aux_updates = op.apply(params, ctx, *in_vals)
 
     if out is not None:
         out_nd = [out] if isinstance(out, NDArray) else list(out)

@@ -78,6 +78,10 @@ def is_running():
     return _STATE["running"]
 
 
+def mode():
+    return _STATE["mode"]
+
+
 def record(name, start_us, end_us, device="tpu/0", category="operator"):
     """Append one op event (called by the executor / dispatcher)."""
     if not _STATE["running"]:

@@ -510,6 +510,246 @@ int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size,
 
 int MXExecutorFree(ExecutorHandle handle) { return FreeHandle(handle); }
 
+// ---------------------------------------------------------------- DataIter
+// (reference c_api.h:1108-1199: create registered iterators from string
+// params; drive next/data/label/pad — the half that lets a non-Python
+// binding TRAIN, not just run forward)
+typedef void *DataIterHandle;
+typedef void *DataIterCreator;
+
+int MXListDataIters(mx_uint *out_size, DataIterCreator **out_array) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  static std::vector<std::string> names;
+  static std::vector<void *> creators;
+  if (names.empty()) {
+    PyObject *lst = nullptr;
+    if (Call("io_list_iters", &lst, "()") != 0) return -1;
+    Py_ssize_t n = PySequence_Size(lst);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *item = PySequence_GetItem(lst, i);
+      const char *s = item != nullptr ? PyUnicode_AsUTF8(item) : nullptr;
+      if (s != nullptr) names.emplace_back(s);
+      Py_XDECREF(item);
+    }
+    Py_DECREF(lst);
+    for (auto &s : names) creators.push_back(&s);
+  }
+  *out_size = static_cast<mx_uint>(creators.size());
+  *out_array = creators.data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterCreator creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names, const char ***arg_types,
+                          const char ***arg_descs) {
+  *name = static_cast<std::string *>(creator)->c_str();
+  if (description != nullptr) *description = "";
+  // param structs are kwargs-typed python-side; expose none statically
+  if (num_args != nullptr) *num_args = 0;
+  if (arg_names != nullptr) *arg_names = nullptr;
+  if (arg_types != nullptr) *arg_types = nullptr;
+  if (arg_descs != nullptr) *arg_descs = nullptr;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterCreator creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  MXTPUGil gil;
+  const char *name = static_cast<std::string *>(creator)->c_str();
+  PyObject *k = StrTuple(num_param, keys);
+  PyObject *v = StrTuple(num_param, vals);
+  PyObject *ret = nullptr;
+  int rc = Call("io_create_iter", &ret, "(sOO)", name, k, v);
+  Py_DECREF(k);
+  Py_DECREF(v);
+  if (rc != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("io_iter_next", &ret, "(O)", handle) != 0) return -1;
+  *out = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  return Call("io_iter_reset", nullptr, "(O)", handle);
+}
+
+static int IterNDLookup(const char *fn, DataIterHandle handle,
+                        NDArrayHandle *out) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call(fn, &ret, "(O)", handle) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return IterNDLookup("io_iter_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return IterNDLookup("io_iter_label", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("io_iter_pad", &ret, "(O)", handle) != 0) return -1;
+  *pad = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) { return FreeHandle(handle); }
+
+// ---------------------------------------------------------------- RecordIO
+// (reference c_api.h:1408-1466)
+typedef void *RecordIOHandle;
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("recio_writer_create", &ret, "(s)", uri) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  // a failed close (e.g. final flush hitting a full disk) must surface:
+  // the caller believes every record was persisted otherwise
+  int rc = Call("recio_close", nullptr, "(O)", handle);
+  FreeHandle(handle);
+  return rc;
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  MXTPUGil gil;
+  PyObject *blob = PyBytes_FromStringAndSize(buf, size);
+  if (blob == nullptr) return MXTPUFail("MXRecordIOWriterWriteRecord");
+  int rc = Call("recio_write", nullptr, "(ON)", handle, blob);
+  return rc;
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("recio_tell", &ret, "(O)", handle) != 0) return -1;
+  *pos = static_cast<size_t>(PyLong_AsSize_t(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("recio_reader_create", &ret, "(s)", uri) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  int rc = Call("recio_close", nullptr, "(O)", handle);
+  FreeHandle(handle);
+  return rc;
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
+                               size_t *size) {
+  // end of stream: *buf=nullptr (reference contract).  A zero-length
+  // RECORD is valid and distinct: non-null *buf with *size=0.
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("recio_read", &ret, "(O)", handle) != 0) return -1;
+  if (ret == Py_None) {
+    *buf = nullptr;
+    *size = 0;
+    Py_DECREF(ret);
+    return 0;
+  }
+  char *data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(ret, &data, &len) != 0) {
+    Py_DECREF(ret);
+    return MXTPUFail("MXRecordIOReaderReadRecord");
+  }
+  tl_json.assign(data, len);
+  *buf = tl_json.data();   // non-null even for an empty record
+  *size = static_cast<size_t>(len);
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  return Call("recio_seek", nullptr, "(On)",
+              handle, static_cast<Py_ssize_t>(pos));
+}
+
+// ---------------------------------------------------------------- Autograd
+// (reference c_api.h:539-558)
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("ag_set_is_training", &ret, "(i)", is_training) != 0) return -1;
+  if (prev != nullptr) *prev = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles) {
+  MXTPUGil gil;
+  PyObject *vars = ObjTuple(num_var, var_handles);
+  PyObject *grads = ObjTuple(num_var, grad_handles);
+  PyObject *reqs = PyTuple_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i)
+    PyTuple_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  int rc = Call("ag_mark_variables", nullptr, "(OOO)", vars, reqs, grads);
+  Py_DECREF(vars);
+  Py_DECREF(grads);
+  Py_DECREF(reqs);
+  return rc;
+}
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  MXTPUGil gil;
+  PyObject *outs = ObjTuple(num_output, output_handles);
+  int rc = Call("ag_compute_gradient", nullptr, "(O)", outs);
+  Py_DECREF(outs);
+  return rc;
+}
+
+// ---------------------------------------------------------------- Profiler
+// (reference c_api.h:183-194)
+int MXSetProfilerConfig(int mode, const char *filename) {
+  MXTPUEnsurePython();
+  return Call("prof_set_config", nullptr, "(is)", mode, filename);
+}
+
+int MXSetProfilerState(int state) {
+  MXTPUEnsurePython();
+  return Call("prof_set_state", nullptr, "(i)", state);
+}
+
+int MXDumpProfile() {
+  MXTPUEnsurePython();
+  return Call("prof_dump", nullptr, "()");
+}
+
 // ----------------------------------------------------------------- KVStore
 int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
   MXTPUEnsurePython();

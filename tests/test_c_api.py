@@ -298,3 +298,208 @@ def test_wrapper_generator_is_current(tmp_path):
     assert fresh == committed, \
         "cpp-package/include/mxtpu_ops.hpp is stale; re-run " \
         "tools/gen_cpp_wrappers.py"
+
+
+def _write_synth_mnist(tmp_path, n=200):
+    """MNIST-format files with a learnable rule: the lit quadrant block
+    encodes the class (4 classes, labels 0-3)."""
+    import gzip
+    import struct
+    rng = np.random.RandomState(0)
+    images = np.zeros((n, 28, 28), np.uint8)
+    labels = (np.arange(n) % 4).astype(np.uint8)
+    off = {0: (2, 2), 1: (2, 16), 2: (16, 2), 3: (16, 16)}
+    for i in range(n):
+        r, c = off[int(labels[i])]
+        images[i, r:r + 10, c:c + 10] = 250
+        images[i] += rng.randint(0, 20, (28, 28), dtype=np.uint8)
+    img_path = str(tmp_path / "img-idx3-ubyte")
+    lbl_path = str(tmp_path / "lbl-idx1-ubyte")
+    with open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with open(lbl_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return img_path, lbl_path
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_dataiter_group(tmp_path):
+    """MXListDataIters / MXDataIterCreateIter / Next / GetData / GetLabel
+    / GetPadNum / BeforeFirst (reference c_api.h:1108-1199)."""
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    img, lbl = _write_synth_mnist(tmp_path, n=50)
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    ok(lib.MXListDataIters(ctypes.byref(n), ctypes.byref(creators)))
+    found = None
+    name_p = ctypes.c_char_p()
+    for i in range(n.value):
+        ok(lib.MXDataIterGetIterInfo(ctypes.c_void_p(creators[i]),
+                                     ctypes.byref(name_p), None, None,
+                                     None, None, None))
+        if name_p.value == b"MNISTIter":
+            found = ctypes.c_void_p(creators[i])
+    assert found is not None and n.value >= 4
+
+    keys = (ctypes.c_char_p * 5)(b"image", b"label", b"batch_size",
+                                 b"shuffle", b"silent")
+    vals = (ctypes.c_char_p * 5)(img.encode(), lbl.encode(), b"16",
+                                 b"False", b"True")
+    it = ctypes.c_void_p()
+    ok(lib.MXDataIterCreateIter(found, 5, keys, vals, ctypes.byref(it)))
+
+    batches = 0
+    total_pad = 0
+    labels_seen = []
+    more = ctypes.c_int()
+    while True:
+        ok(lib.MXDataIterNext(it, ctypes.byref(more)))
+        if not more.value:
+            break
+        batches += 1
+        d = ctypes.c_void_p()
+        ok(lib.MXDataIterGetData(it, ctypes.byref(d)))
+        dim = ctypes.c_uint()
+        pshape = ctypes.POINTER(ctypes.c_uint)()
+        ok(lib.MXNDArrayGetShape(d, ctypes.byref(dim), ctypes.byref(pshape)))
+        assert [pshape[i] for i in range(dim.value)] == [16, 1, 28, 28]
+        lb = ctypes.c_void_p()
+        ok(lib.MXDataIterGetLabel(it, ctypes.byref(lb)))
+        got = np.zeros(16, "f")
+        ok(lib.MXNDArraySyncCopyToCPU(
+            lb, got.ctypes.data_as(ctypes.c_void_p), got.size))
+        labels_seen.append(got)
+        pad = ctypes.c_int()
+        ok(lib.MXDataIterGetPadNum(it, ctypes.byref(pad)))
+        total_pad += pad.value
+        lib.MXNDArrayFree(d)
+        lib.MXNDArrayFree(lb)
+    assert batches == 4 and total_pad == 14      # 50 samples, batch 16
+    np.testing.assert_allclose(labels_seen[0][:4], [0, 1, 2, 3])
+
+    # rewind replays the epoch
+    ok(lib.MXDataIterBeforeFirst(it))
+    ok(lib.MXDataIterNext(it, ctypes.byref(more)))
+    assert more.value == 1
+    lib.MXDataIterFree(it)
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_recordio_autograd_profiler(tmp_path):
+    """RecordIO reader/writer, autograd mark/compute, profiler
+    set-config/dump through the C ABI (c_api.h:1408-1466, :539-558,
+    :183-194)."""
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    # --- RecordIO round-trip
+    uri = str(tmp_path / "t.rec")
+    w = ctypes.c_void_p()
+    ok(lib.MXRecordIOWriterCreate(uri.encode(), ctypes.byref(w)))
+    # includes a zero-length record: valid, distinct from end-of-stream
+    payloads = [b"hello", b"", b"x" * 1000, b"\x0a\x23\xd7\xce" * 8]
+    for p in payloads:
+        ok(lib.MXRecordIOWriterWriteRecord(w, p, len(p)))
+    pos = ctypes.c_size_t()
+    ok(lib.MXRecordIOWriterTell(w, ctypes.byref(pos)))
+    assert pos.value > 0
+    ok(lib.MXRecordIOWriterFree(w))
+
+    r = ctypes.c_void_p()
+    ok(lib.MXRecordIOReaderCreate(uri.encode(), ctypes.byref(r)))
+    got = []
+    while True:
+        buf = ctypes.c_void_p()
+        size = ctypes.c_size_t()
+        ok(lib.MXRecordIOReaderReadRecord(r, ctypes.byref(buf),
+                                          ctypes.byref(size)))
+        if not buf.value:                # EOF = null buffer
+            break
+        got.append(ctypes.string_at(buf.value, size.value))
+    assert got == payloads
+    ok(lib.MXRecordIOReaderFree(r))
+
+    # --- autograd: d(sum(x*x))/dx = 2x
+    shape = (ctypes.c_uint * 1)(4)
+    x = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(x)))
+    xs = np.array([1.0, 2.0, 3.0, 4.0], "f")
+    ok(lib.MXNDArraySyncCopyFromCPU(
+        x, xs.ctypes.data_as(ctypes.c_void_p), xs.size))
+    g = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(g)))
+
+    prev = ctypes.c_int()
+    ok(lib.MXAutogradSetIsTraining(1, ctypes.byref(prev)))
+    var_h = (ctypes.c_void_p * 1)(x)
+    req = (ctypes.c_uint * 1)(1)                  # kWriteTo
+    grad_h = (ctypes.c_void_p * 1)(g)
+    ok(lib.MXAutogradMarkVariables(1, var_h, req, grad_h))
+
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(ctypes.c_void_p)()
+    ins = (ctypes.c_void_p * 2)(x, x)
+    ok(lib.MXImperativeInvokeByName(b"_mul", 2, ins, ctypes.byref(n_out),
+                                    ctypes.byref(outs), 0, None, None))
+    heads = (ctypes.c_void_p * 1)(outs[0])
+    ok(lib.MXAutogradComputeGradient(1, heads))
+    ok(lib.MXAutogradSetIsTraining(0, ctypes.byref(prev)))
+    gv = np.zeros(4, "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        g, gv.ctypes.data_as(ctypes.c_void_p), gv.size))
+    np.testing.assert_allclose(gv, 2 * xs, rtol=1e-5)
+    lib.MXNDArrayFree(x)
+    lib.MXNDArrayFree(g)
+
+    # --- profiler: config -> run -> stop -> dump produces Chrome JSON
+    import json
+    fname = str(tmp_path / "prof.json")
+    ok(lib.MXSetProfilerConfig(1, fname.encode()))
+    ok(lib.MXSetProfilerState(1))
+    a = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(a)))
+    ins1 = (ctypes.c_void_p * 1)(a)
+    ok(lib.MXImperativeInvokeByName(b"sqrt", 1, ins1, ctypes.byref(n_out),
+                                    ctypes.byref(outs), 0, None, None))
+    ok(lib.MXSetProfilerState(0))
+    ok(lib.MXDumpProfile())
+    events = json.load(open(fname))["traceEvents"]
+    assert events, "profiler dump is empty"
+    lib.MXNDArrayFree(a)
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_cpp_train_lenet_through_c_abi(tmp_path):
+    """The C ABI's training story end-to-end: a C++ program (no Python)
+    composes LeNet, feeds MNISTIter, runs forward/backward and SGD, and
+    must LEARN (the reference cpp-package lenet.cpp contract)."""
+    import shutil
+    import subprocess
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cpp = os.path.join(root, "cpp-package")
+    subprocess.run(["make", "-C", cpp, "train_lenet"], check=True,
+                   capture_output=True)
+    img, lbl = _write_synth_mnist(tmp_path, n=200)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    res = subprocess.run(
+        [os.path.join(cpp, "train_lenet"), img, lbl, "6", "0.9"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=root)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "train lenet OK" in res.stdout
