@@ -91,16 +91,18 @@ int main(int argc, char **argv) {
   try {
     Symbol net = LeNet();
 
-    DataIter train("MNISTIter", KWArgs{{"image", images},
-                                       {"label", labels},
-                                       {"batch_size", "20"},
-                                       {"shuffle", "False"},
-                                       {"silent", "True"},
-                                       {"flat", "False"}});
+    const int b = static_cast<int>(kBatch);
+    DataIter train("MNISTIter",
+                   KWArgs{{"image", images},
+                          {"label", labels},
+                          {"batch_size", std::to_string(kBatch)},
+                          {"shuffle", "False"},
+                          {"silent", "True"},
+                          {"flat", "False"}});
 
     Executor exec(net,
-                  {{"data", Shape{20, 1, 28, 28}},
-                   {"softmax_label", Shape{20}}},
+                  {{"data", Shape{b, 1, 28, 28}},
+                   {"softmax_label", Shape{b}}},
                   /*dev_type=*/6, /*dev_id=*/0);
 
     // init every trainable arg host-side, upload once

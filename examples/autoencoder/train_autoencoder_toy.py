@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Stacked autoencoder (reference ``example/autoencoder/autoencoder.py``
++ ``model.py``), toy-sized: greedy layer-wise pretraining of each
+encoder/decoder pair, then end-to-end finetuning of the full
+reconstruction — the reference's two-phase recipe — on synthetic data
+with a low-dimensional latent structure the bottleneck must capture.
+
+Run: python examples/autoencoder/train_autoencoder_toy.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+DIMS = (64, 32, 8)           # input -> hidden -> bottleneck
+
+
+def ae_symbol(layer_dims, out_dim):
+    """Encoder stack + mirrored decoder with a regression output."""
+    data = mx.sym.Variable("data")
+    h = data
+    for i, d in enumerate(layer_dims):
+        h = mx.sym.FullyConnected(h, num_hidden=d, name="enc%d" % i)
+        h = mx.sym.Activation(h, act_type="relu")
+    for i, d in enumerate(tuple(reversed(layer_dims[:-1])) + (out_dim,)):
+        h = mx.sym.FullyConnected(h, num_hidden=d, name="dec%d" % i)
+        if i < len(layer_dims) - 1:
+            h = mx.sym.Activation(h, act_type="relu")
+    return mx.sym.LinearRegressionOutput(h, mx.sym.Variable("label"),
+                                         name="recon")
+
+
+# one fixed projection: train and validation share the latent subspace
+_PROJ = np.random.RandomState(1234).normal(0, 1, (6, DIMS[0])).astype("f")
+
+
+def make_data(rng, n):
+    """Observations = fixed projection of a 6-d latent (plus noise):
+    an 8-wide bottleneck can reconstruct them, random weights cannot."""
+    latent = rng.normal(0, 1, (n, 6)).astype("f")
+    return latent @ _PROJ + rng.normal(0, 0.05, (n, DIMS[0])).astype("f")
+
+
+def train_stage(sym, X, lr, epochs, batch, arg_params=None):
+    it = mx.io.NDArrayIter(X, X.copy(), batch_size=batch, shuffle=True,
+                           label_name="label")
+    mod = mx.mod.Module(sym, label_names=("label",))
+    mod.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": lr},
+            arg_params=arg_params, allow_missing=True,
+            initializer=mx.init.Xavier())
+    return dict(mod.get_params()[0]), mod
+
+
+def mse(mod, X, batch):
+    it = mx.io.NDArrayIter(X, X.copy(), batch_size=batch,
+                           label_name="label")
+    return dict(mod.score(it, mx.metric.MSE()))["mse"]
+
+
+def main():
+    parser = argparse.ArgumentParser(description="toy stacked AE")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--pretrain-epoch", type=int, default=8)
+    parser.add_argument("--finetune-epoch", type=int, default=12)
+    parser.add_argument("--lr", type=float, default=2e-3)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    X = make_data(rng, 768)
+    Xv = make_data(rng, 128)
+    base_var = float((Xv ** 2).mean())
+
+    # --- greedy layer-wise pretraining (reference autoencoder recipe):
+    # each stage trains one encoder/decoder pair on the previous
+    # stage's codes
+    params = {}
+    codes = X
+    for i in range(len(DIMS) - 1):
+        pair = ae_symbol((DIMS[i + 1],), codes.shape[1])
+        stage_params, mod = train_stage(
+            pair, codes, args.lr, args.pretrain_epoch, args.batch_size)
+        params["enc%d_weight" % i] = stage_params["enc0_weight"]
+        params["enc%d_bias" % i] = stage_params["enc0_bias"]
+        params["dec%d_weight" % (len(DIMS) - 2 - i)] = \
+            stage_params["dec0_weight"]
+        params["dec%d_bias" % (len(DIMS) - 2 - i)] = \
+            stage_params["dec0_bias"]
+        # encode for the next stage: data -> relu(enc0)
+        codes = np.maximum(
+            codes @ stage_params["enc0_weight"].asnumpy().T +
+            stage_params["enc0_bias"].asnumpy(), 0.0)
+        logging.info("pretrained stage %d (%d -> %d)", i, DIMS[i],
+                     DIMS[i + 1])
+
+    # --- end-to-end finetune from the pretrained stack
+    full = ae_symbol(DIMS[1:], DIMS[0])
+    _, mod = train_stage(full, X, args.lr, args.finetune_epoch,
+                         args.batch_size, arg_params=params)
+    err = mse(mod, Xv, args.batch_size)
+    ratio = err / base_var
+    logging.info("val reconstruction mse %.4f (data var %.4f, ratio "
+                 "%.3f)", err, base_var, ratio)
+    return 0 if ratio < 0.15 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Kim-style CNN for sentence classification (reference
+``example/cnn_text_classification/text_cnn.py``), toy-sized: Embedding
+-> parallel Convolutions with window sizes (3, 4, 5) over the token
+axis -> max-over-time Pooling -> Concat -> Dropout -> FullyConnected ->
+SoftmaxOutput, trained on synthetic token sequences whose class is
+determined by which "trigger" n-gram appears.
+
+Run: python examples/cnn_text_classification/train_text_cnn_toy.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+VOCAB = 50
+SEQ_LEN = 24
+EMBED = 16
+NUM_CLASSES = 3
+# each class is marked by its own trigger trigram somewhere in the text
+TRIGGERS = {0: (7, 8, 9), 1: (20, 21, 22), 2: (33, 34, 35)}
+
+
+def build_symbol(num_filter=8, windows=(3, 4, 5), dropout=0.5):
+    data = mx.sym.Variable("data")                  # (batch, seq_len)
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                             name="embed")          # (b, seq, embed)
+    x = mx.sym.Reshape(embed, shape=(0, 1, SEQ_LEN, EMBED))
+    pooled = []
+    for w in windows:
+        conv = mx.sym.Convolution(x, kernel=(w, EMBED),
+                                  num_filter=num_filter,
+                                  name="conv%d" % w)
+        act = mx.sym.Activation(conv, act_type="relu")
+        pooled.append(mx.sym.Pooling(act, pool_type="max",
+                                     kernel=(SEQ_LEN - w + 1, 1)))
+    h = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Flatten(h)
+    h = mx.sym.Dropout(h, p=dropout)
+    h = mx.sym.FullyConnected(h, num_hidden=NUM_CLASSES, name="fc")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def make_dataset(rng, n):
+    X = rng.randint(0, VOCAB, (n, SEQ_LEN)).astype("f")
+    Y = rng.randint(0, NUM_CLASSES, (n,)).astype("f")
+    for i in range(n):
+        tri = TRIGGERS[int(Y[i])]
+        pos = rng.randint(0, SEQ_LEN - len(tri))
+        X[i, pos:pos + len(tri)] = tri
+        # scrub other classes' triggers that landed by chance
+        for c, other in TRIGGERS.items():
+            if c == int(Y[i]):
+                continue
+            for p in range(SEQ_LEN - len(other) + 1):
+                if (p > pos + 3 or p + 3 < pos) and \
+                        tuple(X[i, p:p + 3]) == other:
+                    X[i, p] = 0
+    return X, Y
+
+
+def main():
+    parser = argparse.ArgumentParser(description="toy text-CNN")
+    parser.add_argument("--batch-size", type=int, default=32)
+    parser.add_argument("--num-epoch", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--min-acc", type=float, default=0.85)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    X, Y = make_dataset(rng, 512)
+    Xv, Yv = make_dataset(rng, 128)
+    train = mx.io.NDArrayIter(X, Y, batch_size=args.batch_size,
+                              shuffle=True)
+    val = mx.io.NDArrayIter(Xv, Yv, batch_size=args.batch_size)
+
+    mod = mx.mod.Module(build_symbol())
+    mod.fit(train, eval_data=val, num_epoch=args.num_epoch,
+            optimizer="adam", optimizer_params={"learning_rate":
+                                                args.lr / 100},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       frequent=8))
+    val.reset()
+    acc = dict(mod.score(val, mx.metric.Accuracy()))["accuracy"]
+    logging.info("validation accuracy: %.3f", acc)
+    return 0 if acc >= args.min_acc else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

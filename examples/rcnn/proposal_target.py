@@ -1,0 +1,116 @@
+"""proposal_target: assign classification + regression targets to RPN
+proposals, as a python CustomOp — the same architecture as the
+reference's ``example/rcnn/rcnn/symbol/proposal_target.py`` (a
+``mx.operator.CustomOp`` spliced between ``Proposal`` and
+``ROIPooling``), sized for the toy single-object task.
+
+Inputs:  rois ``(B*R, 5)`` [batch_idx, x1, y1, x2, y2] from Proposal,
+         gt_boxes ``(B, 1, 5)`` [x1, y1, x2, y2, cls>=1].
+Outputs: rois (passed through), label ``(B*R,)`` (1 fg / 0 bg),
+         bbox_target ``(B*R, 4*num_classes)``, bbox_weight (same shape,
+         1.0 on the fg class's 4 columns).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def box_iou(boxes, gt):
+    """IoU of each box (N,4) against one gt box (4,)."""
+    x1 = np.maximum(boxes[:, 0], gt[0])
+    y1 = np.maximum(boxes[:, 1], gt[1])
+    x2 = np.minimum(boxes[:, 2], gt[2])
+    y2 = np.minimum(boxes[:, 3], gt[3])
+    inter = np.maximum(x2 - x1 + 1, 0) * np.maximum(y2 - y1 + 1, 0)
+    area = ((boxes[:, 2] - boxes[:, 0] + 1) *
+            (boxes[:, 3] - boxes[:, 1] + 1))
+    gt_area = (gt[2] - gt[0] + 1) * (gt[3] - gt[1] + 1)
+    return inter / np.maximum(area + gt_area - inter, 1e-9)
+
+
+def encode_boxes(boxes, gt):
+    """Box regression deltas (dx, dy, dw, dh), unit variances — the
+    inverse of the Proposal op's decode."""
+    w = boxes[:, 2] - boxes[:, 0] + 1.0
+    h = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * (w - 1)
+    cy = boxes[:, 1] + 0.5 * (h - 1)
+    gw = gt[2] - gt[0] + 1.0
+    gh = gt[3] - gt[1] + 1.0
+    gcx = gt[0] + 0.5 * (gw - 1)
+    gcy = gt[1] + 0.5 * (gh - 1)
+    return np.stack([(gcx - cx) / w, (gcy - cy) / h,
+                     np.log(gw / w), np.log(gh / h)], axis=1)
+
+
+@mx.operator.register("toy_proposal_target")
+class ProposalTargetProp(mx.operator.CustomOpProp):
+    def __init__(self, num_classes="2", fg_overlap="0.5"):
+        super().__init__(need_top_grad=False)
+        self.num_classes = int(num_classes)
+        self.fg_overlap = float(fg_overlap)
+
+    def list_arguments(self):
+        return ["rois", "gt_boxes"]
+
+    def list_outputs(self):
+        return ["rois_output", "label", "bbox_target", "bbox_weight"]
+
+    def infer_shape(self, in_shape):
+        rois, gt = in_shape
+        n = rois[0]
+        return ([rois, gt],
+                [rois, (n,), (n, 4 * self.num_classes),
+                 (n, 4 * self.num_classes)], [])
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        num_classes, fg_overlap = self.num_classes, self.fg_overlap
+
+        class ProposalTarget(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                rois = in_data[0].asnumpy().copy()
+                gt = in_data[1].asnumpy()
+                n = rois.shape[0]
+                label = np.zeros((n,), np.float32)
+                target = np.zeros((n, 4 * num_classes), np.float32)
+                weight = np.zeros((n, 4 * num_classes), np.float32)
+                for b in range(gt.shape[0]):
+                    gt_box = gt[b, 0]
+                    cls = int(gt_box[4])
+                    if cls < 1:           # padded gt slot
+                        continue
+                    idx = np.where(rois[:, 0] == b)[0]
+                    if len(idx) == 0:
+                        continue
+                    # the reference's proposal_target appends gt boxes to
+                    # the roi set so the head always sees fg examples;
+                    # here the last roi slot per image becomes the gt box
+                    # (training only — eval scores pure RPN proposals)
+                    if is_train:
+                        rois[idx[-1], 1:5] = gt_box[:4]
+                    iou = box_iou(rois[idx, 1:5], gt_box[:4])
+                    fg = iou >= fg_overlap
+                    label[idx[fg]] = cls
+                    cols = slice(4 * cls, 4 * cls + 4)
+                    target[idx[fg], cols] = encode_boxes(
+                        rois[idx][fg, 1:5], gt_box[:4])
+                    weight[idx[fg], cols] = 1.0
+                self.assign(out_data[0], req[0], mx.nd.array(rois))
+                self.assign(out_data[1], req[1], mx.nd.array(label))
+                self.assign(out_data[2], req[2], mx.nd.array(target))
+                self.assign(out_data[3], req[3], mx.nd.array(weight))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                # targets are sampled from data, not differentiated
+                for i in range(len(in_grad)):
+                    self.assign(in_grad[i], req[i],
+                                mx.nd.zeros(in_grad[i].shape))
+
+        return ProposalTarget()

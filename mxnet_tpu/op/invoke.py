@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
+from .. import profiler as _prof
 from .registry import Op, OpContext
 
 
@@ -28,11 +29,10 @@ def invoke(op: Op, inputs: List["NDArray"], kwargs: Dict, out=None,
 
     aux_states = aux_states or []
     in_vals = [a.data for a in inputs] + [a.data for a in aux_states]
-    from .. import profiler as _prof
     if _prof.is_running() and _prof.mode() == "all":
         # 'all' mode also records imperative dispatches (reference
         # MXSetProfilerConfig mode=1 behavior)
-        with _prof.record_scope(op.name, "imperative"):
+        with _prof.record_scope(op.name, category="imperative"):
             outs, aux_updates = op.apply(params, ctx, *in_vals)
     else:
         outs, aux_updates = op.apply(params, ctx, *in_vals)

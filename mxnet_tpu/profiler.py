@@ -93,9 +93,10 @@ def record(name, start_us, end_us, device="tpu/0", category="operator"):
 class record_scope:
     """Context manager timing one region into the profile."""
 
-    def __init__(self, name, device="tpu/0"):
+    def __init__(self, name, device="tpu/0", category="operator"):
         self.name = name
         self.device = device
+        self.category = category
 
     def __enter__(self):
         self.start = time.perf_counter_ns() // 1000
@@ -104,7 +105,7 @@ class record_scope:
     def __exit__(self, *exc):
         if _STATE["running"]:
             record(self.name, self.start, time.perf_counter_ns() // 1000,
-                   self.device)
+                   self.device, self.category)
 
 
 def dump_profile():

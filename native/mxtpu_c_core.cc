@@ -76,6 +76,8 @@ thread_local std::vector<const char *> tl_ptrs;
 thread_local std::vector<mx_uint> tl_shape;
 thread_local std::vector<void *> tl_handles;
 thread_local std::string tl_json;
+thread_local std::string tl_record;   // RecordIO read buffer: must not
+                                      // alias tl_json (symbol JSON API)
 
 int StringList(PyObject *list, mx_uint *out_size, const char ***out_array) {
   Py_ssize_t n = PySequence_Size(list);
@@ -684,8 +686,8 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, char const **buf,
     Py_DECREF(ret);
     return MXTPUFail("MXRecordIOReaderReadRecord");
   }
-  tl_json.assign(data, len);
-  *buf = tl_json.data();   // non-null even for an empty record
+  tl_record.assign(data, len);
+  *buf = tl_record.data();   // non-null even for an empty record
   *size = static_cast<size_t>(len);
   Py_DECREF(ret);
   return 0;

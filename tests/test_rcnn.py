@@ -1,0 +1,62 @@
+"""Faster R-CNN toy example end-to-end (reference ``example/rcnn`` —
+the hardest op-integration test: Proposal + CustomOp proposal_target +
+ROIPooling + smooth_l1 jointly trained in one symbol)."""
+import os
+import sys
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, "examples", "rcnn"))
+
+import train_rcnn_toy as T                                  # noqa: E402
+from proposal_target import box_iou                         # noqa: E402
+
+
+def test_rcnn_toy_end_to_end():
+    rng = np.random.RandomState(0)
+    B = 4
+    net = T.build_symbol()
+    data_names = ("data", "im_info", "gt_boxes", "rpn_label",
+                  "rpn_bbox_target", "rpn_bbox_weight")
+    mod = mx.mod.Module(net, data_names=data_names, label_names=None)
+    mod.bind(data_shapes=[
+        ("data", (B, 3, T.IMG, T.IMG)), ("im_info", (B, 3)),
+        ("gt_boxes", (B, 1, 5)),
+        ("rpn_label", (B, T.FEAT * T.FEAT * T.K)),
+        ("rpn_bbox_target", (B, 4 * T.K, T.FEAT, T.FEAT)),
+        ("rpn_bbox_weight", (B, 4 * T.K, T.FEAT, T.FEAT))])
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.005,
+                                         "momentum": 0.9, "wd": 1e-4,
+                                         "rescale_grad": 1.0})
+    im_info = np.tile(np.array([T.IMG, T.IMG, 1.0], "f"), (B, 1))
+
+    def feed(imgs, gt):
+        lab, tgt, wgt = T.rpn_targets(gt)
+        return mx.io.DataBatch(
+            data=[mx.nd.array(x) for x in
+                  (imgs, im_info, gt, lab, tgt, wgt)], label=[])
+
+    for _ in range(30):
+        imgs, gt = T.make_batch(rng, B)
+        mod.forward(feed(imgs, gt), is_train=True)
+        mod.backward()
+        mod.update()
+
+    # eval: rois are pure RPN proposals (no gt injection when
+    # is_train=False); the best-scoring roi must find the object
+    imgs, gt = T.make_batch(rng, B)
+    mod.forward(feed(imgs, gt), is_train=False)
+    outs = mod.get_outputs()
+    cls_prob = outs[2].asnumpy().reshape(B, T.POST_NMS, 2)
+    rois = outs[4].asnumpy().reshape(B, T.POST_NMS, 5)
+    hits = 0
+    for b in range(B):
+        best = int(np.argmax(cls_prob[b, :, 1]))
+        if box_iou(rois[b, best:best + 1, 1:5], gt[b, 0, :4])[0] > 0.3:
+            hits += 1
+    assert hits >= B // 2, "recall %d/%d" % (hits, B)
