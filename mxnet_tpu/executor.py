@@ -70,6 +70,33 @@ class _GraphProgram:
         self._jitted = {}
 
     # ------------------------------------------------------------------
+    def _eval_node(self, n, env, aux_vals, aux_out, rng_key, is_train,
+                   monitor=None):
+        """Run one compute node against ``env`` (in-place)."""
+        in_vals = [env[(id(c), i)] for c, i in n.inputs]
+        aux_names = n.aux_names()
+        aux_slots = [self._aux_index["%s_%s" % (n.name, a)]
+                     for a in aux_names]
+        node_aux = [aux_vals[s] for s in aux_slots]
+        if aux_names:
+            node_aux = [jax.lax.stop_gradient(v) for v in node_aux]
+        rng = None
+        if n.op.uses_rng:
+            rng = jax.random.fold_in(rng_key, len(env))
+        ctx = OpContext(is_train=is_train, rng=rng,
+                        platform=self.platform)
+        outs, aux_updates = n.op.apply(n.params, ctx, *(in_vals + node_aux))
+        dev = self.placement.get(n.name)
+        if dev is not None:
+            outs = tuple(jax.device_put(o, dev) for o in outs)
+        for i, v in enumerate(outs):
+            env[(id(n), i)] = v
+            if monitor is not None:
+                monitor("%s_%s" % (n.name, n.op.list_outputs(n.params)[i]),
+                        v)
+        for s, v in zip(aux_slots, aux_updates):
+            aux_out[s] = v
+
     def _eval(self, arg_vals, aux_vals, rng_key, is_train, monitor=None):
         env = {}
         aux_out = list(aux_vals)
@@ -77,28 +104,8 @@ class _GraphProgram:
             if n.is_variable:
                 env[(id(n), 0)] = arg_vals[self._arg_index[n.name]]
                 continue
-            in_vals = [env[(id(c), i)] for c, i in n.inputs]
-            aux_names = n.aux_names()
-            aux_slots = [self._aux_index["%s_%s" % (n.name, a)]
-                         for a in aux_names]
-            node_aux = [aux_vals[s] for s in aux_slots]
-            if aux_names:
-                node_aux = [jax.lax.stop_gradient(v) for v in node_aux]
-            rng = None
-            if n.op.uses_rng:
-                rng = jax.random.fold_in(rng_key, len(env))
-            ctx = OpContext(is_train=is_train, rng=rng,
-                            platform=self.platform)
-            outs, aux_updates = n.op.apply(n.params, ctx, *(in_vals + node_aux))
-            dev = self.placement.get(n.name)
-            if dev is not None:
-                outs = tuple(jax.device_put(o, dev) for o in outs)
-            for i, v in enumerate(outs):
-                env[(id(n), i)] = v
-                if monitor is not None:
-                    monitor("%s_%s" % (n.name, n.op.list_outputs(n.params)[i]), v)
-            for s, v in zip(aux_slots, aux_updates):
-                aux_out[s] = v
+            self._eval_node(n, env, aux_vals, aux_out, rng_key, is_train,
+                            monitor)
         outputs = tuple(env[(id(nd), i)] for nd, i in self.output_entries)
         return outputs, tuple(aux_out)
 
@@ -155,6 +162,7 @@ class Executor:
         self._outputs: List[NDArray] = []
         self._vjp = None
         self._monitor = None
+        self._partial = None      # partial_forward's carried env
         self._rng_counter = 0
 
     @property
@@ -216,6 +224,45 @@ class Executor:
             arr._set_data(v)
         self._outputs = [NDArray(o) for o in outs]
         return self._outputs
+
+    def partial_forward(self, is_train=False, step=0):
+        """Run exactly forward node ``step``; returns the number of steps
+        left (reference ``include/mxnet/executor.h:44-51`` /
+        ``GraphExecutor::PartialForward``: call with increasing ``step``
+        from 0 until 0 is returned).  Eager per-node evaluation — a
+        debugging surface, like monitor mode; outputs are published once
+        the last node has run."""
+        prog = self._prog
+        compute = [n for n in prog.nodes if not n.is_variable]
+        if step >= len(compute):
+            return 0
+        if step == 0:
+            env = {}
+            for n in prog.nodes:
+                if n.is_variable:
+                    env[(id(n), 0)] = self.arg_dict[n.name].data
+            self._partial = (env, [a.data for a in self.aux_arrays],
+                             self._next_key(), 0)
+        if self._partial is None or self._partial[3] != step:
+            raise MXNetError(
+                "partial_forward steps must be issued in order from 0 "
+                "(expected step %s, got %d)"
+                % (self._partial[3] if self._partial else 0, step))
+        env, aux_out, key, _ = self._partial
+        aux_vals = [a.data for a in self.aux_arrays]
+        prog._eval_node(compute[step], env, aux_vals, aux_out, key,
+                        is_train, monitor=None)
+        left = len(compute) - step - 1
+        if left == 0:
+            for arr, v in zip(self.aux_arrays, aux_out):
+                arr._set_data(v)
+            self._outputs = [NDArray(env[(id(nd), i)])
+                             for nd, i in prog.output_entries]
+            self._partial = None
+            self._vjp = None     # outputs no longer match any pullback
+        else:
+            self._partial = (env, aux_out, key, step + 1)
+        return left
 
     def backward(self, out_grads=None):
         if self._vjp is None:

@@ -103,6 +103,10 @@ def _make_custom_fn(op_type, prop_kwargs):
     """Build the pure-JAX body for a Custom node: pure_callback forward +
     custom_vjp backward calling the user's python CustomOp."""
     prop = get_prop_cls(op_type)(**prop_kwargs)
+    return _make_custom_fn_from_prop(prop, "Custom[%s]" % op_type)
+
+
+def _make_custom_fn_from_prop(prop, op_name):
     arg_names = prop.list_arguments()
     out_names = prop.list_outputs()
     n_in, n_out = len(arg_names), len(out_names)
@@ -172,13 +176,145 @@ def _make_custom_fn(op_type, prop_kwargs):
         return out if len(out) > 1 else out[0]
 
     custom_op = Op(
-        name="Custom[%s]" % op_type, fn=fn,
+        name=op_name, fn=fn,
         params_spec=(), input_names=tuple(arg_names),
         aux_names=tuple(prop.list_auxiliary_states()),
         num_outputs=n_out, hint="custom",
         infer_shape=lambda p, in_shapes: prop.infer_shape(in_shapes),
         mode_dependent=True)
     return custom_op
+
+
+def _register_and_create(op, args, kwargs):
+    """Register a freshly-built custom Op (JSON round-trip needs the
+    registry row) and create its symbol node from Symbol inputs."""
+    from .symbol import Symbol, _create
+    bad = [a for a in args if not isinstance(a, Symbol)]
+    if bad:
+        raise MXNetError(
+            "custom op inputs must be Symbols, got %s"
+            % [type(a).__name__ for a in bad])
+    _reg._REGISTRY[op.name] = op
+    return _create(op.name, list(args), dict(kwargs))
+
+
+# ----------------------------------------------------------------------
+# Legacy foreign-function op classes (reference ``operator.py:19-257``:
+# PythonOp -> NumpyOp / NDArrayOp, the pre-CustomOp API behind the
+# ``_Native`` / ``_NDArray`` callback operators,
+# ``src/operator/custom/native_op-inl.h`` / ``ndarray_op-inl.h``).
+# Same subclassing surface; the substrate is the modern Custom machinery
+# (pure_callback + custom_vjp) instead of C function-pointer structs.
+class PythonOp(object):
+    """Base: subclass, override ``forward``/``backward``/``infer_shape``/
+    ``list_arguments``/``list_outputs``; calling the instance on input
+    symbols yields the graph node (reference ``operator.py:19-118``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_arguments(self):
+        return ["data"]
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+    # NumpyOp presents numpy copies (flushed back after the call);
+    # NDArrayOp presents the NDArrays themselves
+    _use_numpy = False
+    _node_kind = "_Python"
+    _instances = 0
+
+    def get_symbol(self, *args, **kwargs):
+        legacy = self
+        use_numpy = self._use_numpy
+
+        def _views(nd_list):
+            # writable copies: asnumpy() views of jax buffers are
+            # read-only, and legacy ops mutate in place
+            return [np.array(a.asnumpy()) for a in nd_list] if use_numpy \
+                else list(nd_list)
+
+        def _flush(nd_list, views):
+            if use_numpy:
+                for dst, v in zip(nd_list, views):
+                    dst[:] = v
+
+        class _Adapter(CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                outs = _views(out_data)
+                legacy.forward(in_data=_views(in_data), out_data=outs)
+                _flush(out_data, outs)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                grads = _views(in_grad)
+                legacy.backward(out_grad=_views(out_grad),
+                                in_data=_views(in_data),
+                                out_data=_views(out_data),
+                                in_grad=grads)
+                _flush(in_grad, grads)
+
+        class _Prop(CustomOpProp):
+            def __init__(self):
+                super().__init__(need_top_grad=legacy.need_top_grad())
+
+            def list_arguments(self):
+                return legacy.list_arguments()
+
+            def list_outputs(self):
+                return legacy.list_outputs()
+
+            def infer_shape(self, in_shape):
+                shapes = legacy.infer_shape(in_shape)
+                # legacy returns (in, out); modern adds aux
+                return (shapes if len(shapes) == 3
+                        else (shapes[0], shapes[1], []))
+
+            def create_operator(self, ctx, in_shapes, in_dtypes):
+                return _Adapter()
+
+        # unique per instance: two differently-configured instances of
+        # the same subclass must not overwrite each other's registry row
+        PythonOp._instances += 1
+        op = _make_custom_fn_from_prop(
+            _Prop(), "%s[%s:%d]" % (self._node_kind, type(self).__name__,
+                                    PythonOp._instances))
+        return _register_and_create(op, args, kwargs)
+
+
+class NumpyOp(PythonOp):
+    """Forward/backward see numpy arrays; mutate ``out_data[i][:]``
+    in place (reference ``operator.py:120-225`` — the ``_Native`` op)."""
+
+    _node_kind = "_Native"
+    _use_numpy = True
+
+
+class NDArrayOp(PythonOp):
+    """Forward/backward see NDArrays directly (reference
+    ``operator.py:226-257`` — the ``_NDArray`` op)."""
+
+    _node_kind = "_NDArray"
+
+
+# alias kept for scripts that imported the C-callback flavor by name
+NativeOp = NumpyOp
 
 
 def _custom_entry(namespace):
@@ -200,13 +336,9 @@ def _custom_entry(namespace):
                 prop_kwargs[k] = kwargs.pop(k)
         op = _make_custom_fn(op_type, prop_kwargs)
         if namespace == "sym":
-            from .symbol import _create, Symbol
-            _reg._REGISTRY[op.name] = op  # needed for JSON round-trip
-            sym_args = [a for a in args if isinstance(a, Symbol)]
-            call_kwargs = dict(kwargs)
             if name is not None:
-                call_kwargs["name"] = name
-            return _create(op.name, sym_args, call_kwargs)
+                kwargs["name"] = name
+            return _register_and_create(op, args, kwargs)
         from .op.invoke import invoke
         arrays = [a for a in args if isinstance(a, NDArray)]
         res = invoke(op, arrays, kwargs)

@@ -93,3 +93,71 @@ def test_imdecode_operator():
                            x0=1, y0=1, x1=4, y1=3, c=2)
     np.testing.assert_array_equal(out2.asnumpy().astype("uint8"),
                                   img[1:3, 1:4, :2])
+
+
+def test_legacy_numpy_op():
+    """The pre-CustomOp foreign-function API (reference
+    ``operator.py:19-225`` NumpyOp -> the ``_Native`` callback op)."""
+
+    class NumpySoftmax(mx.operator.NumpyOp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ["data", "label"]
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]]
+
+        def forward(self, in_data, out_data):
+            x, y = in_data[0], out_data[0]
+            y[:] = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            lab, y, dx = in_data[1], out_data[0], in_grad[0]
+            dx[:] = y.copy()
+            dx[np.arange(lab.shape[0]), lab.astype(np.int32)] -= 1.0
+
+    net = NumpySoftmax()(mx.sym.Variable("data"), name="softmax")
+    rng = np.random.RandomState(0)
+    x = rng.randn(6, 4).astype("f")
+    lab = rng.randint(0, 4, (6,)).astype("f")
+    label_name = [n for n in net.list_arguments() if n != "data"][0]
+    args = {"data": mx.nd.array(x), label_name: mx.nd.array(lab)}
+    grads = {k: mx.nd.zeros(v.shape) for k, v in args.items()}
+    ex = net.bind(mx.cpu(), args=args, args_grad=grads)
+    ex.forward(is_train=True)
+    ref = np.exp(x - x.max(1, keepdims=True))
+    ref /= ref.sum(1, keepdims=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), ref, rtol=1e-5)
+    ex.backward([mx.nd.ones(x.shape)])
+    want = ref.copy()
+    want[np.arange(6), lab.astype(int)] -= 1
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_legacy_ndarray_op():
+    """NDArrayOp flavor (reference ``operator.py:226-257`` — the
+    ``_NDArray`` callback op): forward/backward see NDArrays."""
+
+    class ScaleOp(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 3.0
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 3.0
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]]
+
+    x = np.random.RandomState(0).randn(3, 4).astype("f")
+    net = ScaleOp()(mx.sym.Variable("data"))
+    ex = net.bind(mx.cpu(), args={"data": mx.nd.array(x)},
+                  args_grad={"data": mx.nd.zeros(x.shape)})
+    ex.forward(is_train=True)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), 3 * x, rtol=1e-6)
+    ex.backward([mx.nd.ones(x.shape)])
+    np.testing.assert_allclose(ex.grad_dict["data"].asnumpy(),
+                               np.full_like(x, 3.0), rtol=1e-6)

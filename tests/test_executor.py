@@ -94,3 +94,31 @@ def test_monitor_callback():
     exe.install_monitor(lambda name, arr: seen.append(name))
     exe.forward()
     assert "t_output" in seen and "s_output" in seen
+
+
+def test_partial_forward():
+    """PartialForward contract (reference ``executor.h:44-51``): issue
+    one forward node per call with increasing step until 0 left; final
+    outputs match a whole forward()."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    rng = np.random.RandomState(0)
+    args = {"data": mx.nd.array(rng.randn(3, 5).astype("f")),
+            "fc_weight": mx.nd.array(rng.randn(4, 5).astype("f")),
+            "fc_bias": mx.nd.zeros((4,)),
+            "fc2_weight": mx.nd.array(rng.randn(2, 4).astype("f")),
+            "fc2_bias": mx.nd.zeros((2,))}
+    ex = net.bind(mx.cpu(), args=args)
+    want = ex.forward(is_train=False)[0].asnumpy()
+
+    step = 0
+    left = ex.partial_forward(is_train=False, step=step)
+    steps = 1
+    while left:
+        step += 1
+        left = ex.partial_forward(is_train=False, step=step)
+        steps += 1
+    assert steps == 3            # fc, tanh, fc2
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want, rtol=1e-6)
