@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Fast-gradient-sign adversarial examples (reference
+``example/adversary/adversary_generation.ipynb``): train a classifier,
+then perturb inputs along the sign of the input gradient
+(``inputs_need_grad=True`` through the Module API) and show accuracy
+collapsing at a perturbation humans would not notice.
+
+Run: python examples/adversary/fgsm_toy.py
+"""
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def build_net():
+    h = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=64,
+                              name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    h = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(h, name="softmax")
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    X = rng.normal(0, 1, (1024, 20)).astype("f")
+    Y = (X @ rng.normal(0, 1, (20, 4))).argmax(1).astype("f")
+    batch = 64
+
+    it = mx.io.NDArrayIter(X, Y, batch_size=batch, shuffle=True)
+    mod = mx.mod.Module(build_net())
+    mod.fit(it, num_epoch=15, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2},
+            initializer=mx.init.Xavier())
+    it.reset()
+    clean = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+
+    # adversary module: same params, inputs_need_grad for d(loss)/d(x)
+    adv = mx.mod.Module(build_net())
+    adv.bind(data_shapes=[("data", (batch, 20))],
+             label_shapes=[("softmax_label", (batch,))],
+             inputs_need_grad=True, for_training=True)
+    arg_params, aux_params = mod.get_params()
+    adv.set_params(arg_params, aux_params)
+
+    eps = 0.5
+    correct = total = 0
+    it.reset()
+    for b in it:
+        adv.forward(b, is_train=True)
+        adv.backward()
+        gsign = np.sign(adv.get_input_grads()[0].asnumpy())
+        x_adv = b.data[0].asnumpy() + eps * gsign
+        adv.forward(mx.io.DataBatch(data=[mx.nd.array(x_adv)],
+                                    label=b.label), is_train=False)
+        pred = adv.get_outputs()[0].asnumpy().argmax(1)
+        lab = b.label[0].asnumpy()
+        n = len(lab) - b.pad
+        correct += int((pred[:n] == lab[:n]).sum())
+        total += n
+    fooled = correct / total
+    logging.info("clean accuracy %.3f -> adversarial accuracy %.3f "
+                 "(eps=%.2f)", clean, fooled, eps)
+    # the attack must work: clean model good, adversarial accuracy poor
+    return 0 if clean > 0.9 and fooled < clean - 0.3 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -93,6 +93,52 @@ def op_invoke(name, inputs, keys, vals):
     return list(outs)
 
 
+def op_describe(name):
+    """(num_use_vars, num_scalars, num_mutate_vars, type_mask) for the
+    legacy Function API (reference ``MXFuncDescribe``, c_api.h:219-233);
+    scalars ride kwargs here, so the scalar slot is always 0."""
+    from .op import registry
+    op = registry.get(name)
+    # ops whose arity depends on params (Concat, SliceChannel, ...)
+    # raise here -> MXFuncDescribe returns -1: fail loudly at the
+    # describe layer rather than fabricate a 1-in/1-out signature
+    params = op.parse_params({})
+    n_in = len(op.list_inputs(params))
+    n_out = (op.num_outputs(params) if callable(op.num_outputs)
+             else op.num_outputs)
+    return int(n_in), 0, int(n_out), 1   # kNDArrayArgBeforeScalar
+
+
+def op_invoke_into(name, inputs, outputs):
+    """Legacy ``MXFuncInvoke``: write results into caller-provided
+    mutate vars (the pre-imperative Function API, c_api.h:234-247)."""
+    from .op import invoke as _invoke
+    from .op import registry
+    op = registry.get(name)
+    outs = _invoke.invoke(op, list(inputs), {})
+    for dst, src in zip(outputs, outs):
+        dst[:] = src
+    return True
+
+
+def executor_set_monitor(executor, fn_ptr, ctx_ptr):
+    """Install a C monitor callback (reference
+    ``MXExecutorSetMonitorCallback``, c_api.h:1049-1053): the raw
+    function pointer is wrapped with ctypes; each tapped tensor is
+    handed over as a NEW NDArrayHandle reference the callback must
+    release with MXNDArrayFree."""
+    import ctypes
+    cb = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)(fn_ptr)
+
+    def monitor(tensor_name, arr):
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(arr))
+        cb(tensor_name.encode(), id(arr), ctx_ptr)
+
+    executor.install_monitor(monitor)
+    return True
+
+
 # ----------------------------------------------------------------------
 # Symbol
 def sym_variable(name):

@@ -198,6 +198,8 @@ class Executor:
             except Exception:
                 pass
 
+        self._partial = None      # a full forward supersedes any
+                                  # in-flight partial sequence
         from . import profiler as _prof
         if self._monitor is not None:
             # per-op tapped evaluation (runs the forward once eagerly to

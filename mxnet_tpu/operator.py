@@ -289,13 +289,16 @@ class PythonOp(object):
             def create_operator(self, ctx, in_shapes, in_dtypes):
                 return _Adapter()
 
-        # unique per instance: two differently-configured instances of
-        # the same subclass must not overwrite each other's registry row
-        PythonOp._instances += 1
-        op = _make_custom_fn_from_prop(
-            _Prop(), "%s[%s:%d]" % (self._node_kind, type(self).__name__,
-                                    PythonOp._instances))
-        return _register_and_create(op, args, kwargs)
+        # build + register once per INSTANCE (unique suffix: two
+        # differently-configured instances of the same subclass must not
+        # overwrite each other's row; re-calls on one instance reuse it)
+        if getattr(self, "_op", None) is None:
+            PythonOp._instances += 1
+            self._op = _make_custom_fn_from_prop(
+                _Prop(), "%s[%s:%d]" % (self._node_kind,
+                                        type(self).__name__,
+                                        PythonOp._instances))
+        return _register_and_create(self._op, args, kwargs)
 
 
 class NumpyOp(PythonOp):

@@ -287,14 +287,15 @@ int MXImperativeInvokeByName(const char *op_name, int num_inputs,
   return 0;
 }
 
-// ------------------------------------------------------------------ Symbol
-int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
-                                     AtomicSymbolCreator **out_array) {
-  MXTPUEnsurePython();
-  MXTPUGil gil;
-  // creators are interned name strings; stable for process lifetime
+// -------------------------------------------------------- legacy Functions
+// (reference c_api.h:166-260: the pre-imperative Function API — list
+// registered ops as FunctionHandles, describe arity, invoke into
+// caller-provided mutate vars)
+typedef const void *FunctionHandle;
+
+static int OpNameList(mx_uint *out_size, void ***out_array) {
   static std::vector<std::string> names;
-  static std::vector<void *> creators;
+  static std::vector<void *> handles;
   if (names.empty()) {
     PyObject *lst = nullptr;
     if (Call("op_names", &lst, "()") != 0) return -1;
@@ -306,11 +307,82 @@ int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
       Py_XDECREF(item);
     }
     Py_DECREF(lst);
-    for (auto &s : names) creators.push_back(&s);
+    for (auto &s : names) handles.push_back(&s);
   }
-  *out_size = static_cast<mx_uint>(creators.size());
-  *out_array = creators.data();
+  *out_size = static_cast<mx_uint>(handles.size());
+  *out_array = handles.data();
   return 0;
+}
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  mx_uint n = 0;
+  void **arr = nullptr;
+  if (OpNameList(&n, &arr) != 0) return -1;
+  *out_size = n;
+  *out_array = const_cast<FunctionHandle *>(
+      reinterpret_cast<const FunctionHandle *>(arr));
+  return 0;
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions) {
+  *name = static_cast<const std::string *>(fun)->c_str();
+  if (description != nullptr) *description = "";
+  if (num_args != nullptr) *num_args = 0;
+  if (arg_names != nullptr) *arg_names = nullptr;
+  if (arg_type_infos != nullptr) *arg_type_infos = nullptr;
+  if (arg_descriptions != nullptr) *arg_descriptions = nullptr;
+  return 0;
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("op_describe", &ret, "(s)",
+           static_cast<const std::string *>(fun)->c_str()) != 0)
+    return -1;
+  *num_use_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(ret, 0)));
+  *num_scalars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(ret, 1)));
+  *num_mutate_vars = static_cast<mx_uint>(
+      PyLong_AsUnsignedLong(PyTuple_GetItem(ret, 2)));
+  *type_mask = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(ret, 3)));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  (void)scalar_args;   // scalars ride kwargs in this registry
+  MXTPUGil gil;
+  mx_uint n_use = 0, n_scalar = 0, n_mut = 0;
+  int mask = 0;
+  if (MXFuncDescribe(fun, &n_use, &n_scalar, &n_mut, &mask) != 0)
+    return -1;
+  PyObject *ins = ObjTuple(n_use, use_vars);
+  PyObject *outs = ObjTuple(n_mut, mutate_vars);
+  int rc = Call("op_invoke_into", nullptr, "(sOO)",
+                static_cast<const std::string *>(fun)->c_str(), ins, outs);
+  Py_DECREF(ins);
+  Py_DECREF(outs);
+  return rc;
+}
+
+// ------------------------------------------------------------------ Symbol
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  // creators are the same interned op-name strings the Function API
+  // lists (both registries are one on TPU)
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  return OpNameList(out_size, out_array);
 }
 
 int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
@@ -508,6 +580,22 @@ int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size,
   int rc = HandleList(lst, out_size, reinterpret_cast<void ***>(out));
   Py_DECREF(lst);
   return rc;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle exec,
+                                 void (*callback)(const char *,
+                                                  NDArrayHandle, void *),
+                                 void *callback_handle) {
+  // reference c_api.h:1049-1053: tap every op output during forward.
+  // The python side wraps the raw pointer with ctypes; each tapped
+  // tensor arrives as a NEW NDArrayHandle the callback must release
+  // with MXNDArrayFree.
+  MXTPUGil gil;
+  return Call("executor_set_monitor", nullptr, "(OKK)", exec,
+              static_cast<unsigned long long>(
+                  reinterpret_cast<uintptr_t>(callback)),
+              static_cast<unsigned long long>(
+                  reinterpret_cast<uintptr_t>(callback_handle)));
 }
 
 int MXExecutorFree(ExecutorHandle handle) { return FreeHandle(handle); }

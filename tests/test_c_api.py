@@ -503,3 +503,101 @@ def test_cpp_train_lenet_through_c_abi(tmp_path):
         env=env, capture_output=True, text=True, timeout=600, cwd=root)
     assert res.returncode == 0, res.stdout + res.stderr
     assert "train lenet OK" in res.stdout
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_function_api_and_monitor_callback(tmp_path):
+    """Legacy Function API (MXListFunctions/MXFuncDescribe/MXFuncInvoke,
+    c_api.h:166-260) + the executor monitor C callback
+    (MXExecutorSetMonitorCallback, c_api.h:1049-1053)."""
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    # --- function listing + invoke: sqrt through the legacy API
+    n = ctypes.c_uint()
+    funcs = ctypes.POINTER(ctypes.c_void_p)()
+    ok(lib.MXListFunctions(ctypes.byref(n), ctypes.byref(funcs)))
+    sqrt_h = None
+    name_p = ctypes.c_char_p()
+    for i in range(n.value):
+        ok(lib.MXFuncGetInfo(ctypes.c_void_p(funcs[i]),
+                             ctypes.byref(name_p), None, None, None,
+                             None, None))
+        if name_p.value == b"sqrt":
+            sqrt_h = ctypes.c_void_p(funcs[i])
+    assert sqrt_h is not None and n.value > 200
+
+    nu, ns, nm = ctypes.c_uint(), ctypes.c_uint(), ctypes.c_uint()
+    mask = ctypes.c_int()
+    ok(lib.MXFuncDescribe(sqrt_h, ctypes.byref(nu), ctypes.byref(ns),
+                          ctypes.byref(nm), ctypes.byref(mask)))
+    assert (nu.value, ns.value, nm.value) == (1, 0, 1)
+
+    shape = (ctypes.c_uint * 1)(4)
+    a = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(a)))
+    xs = np.array([1.0, 4.0, 9.0, 16.0], "f")
+    ok(lib.MXNDArraySyncCopyFromCPU(
+        a, xs.ctypes.data_as(ctypes.c_void_p), xs.size))
+    out = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 1, 1, 0, 0, ctypes.byref(out)))
+    use = (ctypes.c_void_p * 1)(a)
+    mut = (ctypes.c_void_p * 1)(out)
+    ok(lib.MXFuncInvoke(sqrt_h, use, None, mut))
+    got = np.zeros(4, "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        out, got.ctypes.data_as(ctypes.c_void_p), got.size))
+    np.testing.assert_allclose(got, [1, 2, 3, 4], rtol=1e-6)
+
+    # --- executor monitor C callback
+    data = ctypes.c_void_p()
+    ok(lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    ok(lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                            ctypes.byref(creators)))
+    fc_creator = None
+    for i in range(n.value):
+        ok(lib.MXSymbolGetAtomicSymbolName(ctypes.c_void_p(creators[i]),
+                                           ctypes.byref(name_p)))
+        if name_p.value == b"FullyConnected":
+            fc_creator = ctypes.c_void_p(creators[i])
+    assert fc_creator is not None
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"3")
+    ok(lib.MXSymbolCreateAtomicSymbol(fc_creator, 1, keys, vals,
+                                      ctypes.byref(fc)))
+    arg_keys = (ctypes.c_char_p * 1)(b"data")
+    arg_vals = (ctypes.c_void_p * 1)(data)
+    ok(lib.MXSymbolCompose(fc, b"fc", 1, arg_keys, arg_vals))
+    exec_h = ctypes.c_void_p()
+    in_keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(2, 4)
+    ok(lib.MXExecutorSimpleBind(fc, 1, 0, 1, in_keys, indptr, shape_data,
+                                b"write", ctypes.byref(exec_h)))
+
+    seen = []
+    CB = ctypes.CFUNCTYPE(None, ctypes.c_char_p, ctypes.c_void_p,
+                          ctypes.c_void_p)
+
+    def on_tensor(tensor_name, nd_handle, _ctx):
+        seen.append(tensor_name.decode())
+        # contract: callee releases (wrap in c_void_p — a bare int would
+        # marshal as 32-bit c_int and truncate the pointer)
+        lib.MXNDArrayFree(ctypes.c_void_p(nd_handle))
+
+    cb = CB(on_tensor)
+    ok(lib.MXExecutorSetMonitorCallback(exec_h, cb, None))
+    ok(lib.MXExecutorForward(exec_h, 1))
+    assert any("fc" in s for s in seen), seen
+
+    lib.MXExecutorFree(exec_h)
+    lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(data)
+    lib.MXNDArrayFree(a)
+    lib.MXNDArrayFree(out)
