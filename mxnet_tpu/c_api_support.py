@@ -51,6 +51,31 @@ def nd_shape(handle):
     return tuple(int(d) for d in handle.shape)
 
 
+def nd_slice(handle, begin, end):
+    return handle[int(begin):int(end)]
+
+
+def nd_at(handle, idx):
+    return handle[int(idx)]
+
+
+def nd_reshape(handle, shape):
+    return handle.reshape(tuple(int(d) for d in shape))
+
+
+def nd_dtype(handle):
+    """Type flag in the framework's canonical (mshadow-compatible)
+    ordering — one table, base.py's."""
+    from .base import _DTYPE_NP_TO_MX
+    return int(_DTYPE_NP_TO_MX.get(np.dtype(handle.dtype), 0))
+
+
+def nd_context(handle):
+    ctx = handle.context
+    types = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 6}
+    return types.get(ctx.device_type, 6), int(ctx.device_id)
+
+
 def nd_save(fname, handles, names):
     if names:
         nd.save(fname, dict(zip(names, handles)))
@@ -104,9 +129,7 @@ def op_describe(name):
     # describe layer rather than fabricate a 1-in/1-out signature
     params = op.parse_params({})
     n_in = len(op.list_inputs(params))
-    n_out = (op.num_outputs(params) if callable(op.num_outputs)
-             else op.num_outputs)
-    return int(n_in), 0, int(n_out), 1   # kNDArrayArgBeforeScalar
+    return int(n_in), 0, int(op.n_outputs(params)), 1  # NDArray-first
 
 
 def op_invoke_into(name, inputs, outputs):
@@ -115,9 +138,7 @@ def op_invoke_into(name, inputs, outputs):
     from .op import invoke as _invoke
     from .op import registry
     op = registry.get(name)
-    outs = _invoke.invoke(op, list(inputs), {})
-    for dst, src in zip(outputs, outs):
-        dst[:] = src
+    _invoke.invoke(op, list(inputs), {}, out=list(outputs))
     return True
 
 

@@ -258,6 +258,60 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   return rc;
 }
 
+int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
+                   mx_uint slice_end, NDArrayHandle *out) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("nd_slice", &ret, "(OII)", handle, slice_begin,
+           slice_end) != 0)
+    return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("nd_at", &ret, "(OI)", handle, idx) != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, int *dims,
+                     NDArrayHandle *out) {
+  MXTPUGil gil;
+  PyObject *shape = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shape, i, PyLong_FromLong(dims[i]));
+  PyObject *ret = nullptr;
+  int rc = Call("nd_reshape", &ret, "(OO)", handle, shape);
+  Py_DECREF(shape);
+  if (rc != 0) return -1;
+  *out = ret;
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out_dtype) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("nd_dtype", &ret, "(O)", handle) != 0) return -1;
+  *out_dtype = static_cast<int>(PyLong_AsLong(ret));
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("nd_context", &ret, "(O)", handle) != 0) return -1;
+  *out_dev_type = static_cast<int>(
+      PyLong_AsLong(PyTuple_GetItem(ret, 0)));
+  *out_dev_id = static_cast<int>(PyLong_AsLong(PyTuple_GetItem(ret, 1)));
+  Py_DECREF(ret);
+  return 0;
+}
+
 int MXNDArrayFree(NDArrayHandle handle) { return FreeHandle(handle); }
 
 // --------------------------------------------------------------- operators

@@ -601,3 +601,52 @@ def test_c_function_api_and_monitor_callback(tmp_path):
     lib.MXSymbolFree(data)
     lib.MXNDArrayFree(a)
     lib.MXNDArrayFree(out)
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_ndarray_views_and_meta():
+    """MXNDArraySlice/At/Reshape/GetDType/GetContext
+    (reference c_api.h:330-405)."""
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    shape = (ctypes.c_uint * 2)(4, 3)
+    a = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(a)))
+    xs = np.arange(12, dtype="f").reshape(4, 3)
+    ok(lib.MXNDArraySyncCopyFromCPU(
+        a, xs.ctypes.data_as(ctypes.c_void_p), xs.size))
+
+    def read(h, n):
+        out = np.zeros(n, "f")
+        ok(lib.MXNDArraySyncCopyToCPU(
+            h, out.ctypes.data_as(ctypes.c_void_p), out.size))
+        return out
+
+    s = ctypes.c_void_p()
+    ok(lib.MXNDArraySlice(a, 1, 3, ctypes.byref(s)))
+    np.testing.assert_allclose(read(s, 6), xs[1:3].reshape(-1))
+
+    at = ctypes.c_void_p()
+    ok(lib.MXNDArrayAt(a, 2, ctypes.byref(at)))
+    np.testing.assert_allclose(read(at, 3), xs[2])
+
+    r = ctypes.c_void_p()
+    dims = (ctypes.c_int * 2)(6, 2)
+    ok(lib.MXNDArrayReshape(a, 2, dims, ctypes.byref(r)))
+    np.testing.assert_allclose(read(r, 12), xs.reshape(-1))
+
+    dt = ctypes.c_int()
+    ok(lib.MXNDArrayGetDType(a, ctypes.byref(dt)))
+    assert dt.value == 0                    # float32
+
+    devt, devid = ctypes.c_int(), ctypes.c_int()
+    ok(lib.MXNDArrayGetContext(a, ctypes.byref(devt), ctypes.byref(devid)))
+    assert devt.value in (1, 6) and devid.value == 0
+
+    for h in (s, at, r, a):
+        lib.MXNDArrayFree(h)
