@@ -16,12 +16,20 @@ import numpy as np
 from . import kvstore as _kvstore
 from . import ndarray as nd
 from . import symbol as sym
-from .base import Context
+from .base import Context, MXNetError
+
+
+# ONE device-type table; both directions derive from it (the ABI ids of
+# the reference's Context enum, with tpu at 6)
+_DEVTYPE_TO_NAME = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 6: "tpu"}
+_NAME_TO_DEVTYPE = {v: k for k, v in _DEVTYPE_TO_NAME.items()}
 
 
 def _ctx(dev_type, dev_id):
-    names = {1: "cpu", 2: "gpu", 3: "cpu", 6: "tpu"}
-    return Context(names.get(int(dev_type), "tpu"), int(dev_id))
+    name = _DEVTYPE_TO_NAME.get(int(dev_type), "tpu")
+    if name == "cpu_pinned":        # pinned host memory = host memory here
+        name = "cpu"
+    return Context(name, int(dev_id))
 
 
 # ----------------------------------------------------------------------
@@ -52,15 +60,34 @@ def nd_shape(handle):
 
 
 def nd_slice(handle, begin, end):
-    return handle[int(begin):int(end)]
+    # eager bounds checks: the reference CHECKs at the C layer; JAX's
+    # lazy views would otherwise defer (or silently clip) the error
+    begin, end = int(begin), int(end)
+    n = handle.shape[0]
+    if not 0 <= begin <= end <= n:
+        raise MXNetError("slice [%d, %d) out of bounds for axis of %d"
+                         % (begin, end, n))
+    return handle[begin:end]
 
 
 def nd_at(handle, idx):
-    return handle[int(idx)]
+    idx = int(idx)
+    if not 0 <= idx < handle.shape[0]:
+        raise MXNetError("index %d out of bounds for axis of %d"
+                         % (idx, handle.shape[0]))
+    return handle[idx]
 
 
 def nd_reshape(handle, shape):
-    return handle.reshape(tuple(int(d) for d in shape))
+    shape = tuple(int(d) for d in shape)
+    known = int(np.prod([d for d in shape if d != -1]))
+    if shape.count(-1) > 1 or (shape.count(-1) == 0 and
+                               known != handle.size) or \
+            (shape.count(-1) == 1 and
+             (known == 0 or handle.size % known)):
+        raise MXNetError("cannot reshape %s array into %s"
+                         % (handle.shape, (shape,)))
+    return handle.reshape(shape)
 
 
 def nd_dtype(handle):
@@ -72,8 +99,8 @@ def nd_dtype(handle):
 
 def nd_context(handle):
     ctx = handle.context
-    types = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 6}
-    return types.get(ctx.device_type, 6), int(ctx.device_id)
+    return (_NAME_TO_DEVTYPE.get(ctx.device_type, 6),
+            int(ctx.device_id))
 
 
 def nd_save(fname, handles, names):
@@ -89,6 +116,30 @@ def nd_load(fname):
         names = list(loaded.keys())
         return [loaded[n] for n in names], names
     return list(loaded), []
+
+
+def nd_save_raw(handle):
+    """Single-array chunk bytes (reference ``MXNDArraySaveRawBytes`` —
+    the NDArray::Save chunk without the file container)."""
+    import io as _pyio
+    buf = _pyio.BytesIO()
+    nd._save_one(buf, handle)
+    return buf.getvalue()
+
+
+def nd_load_raw(blob):
+    import io as _pyio
+    return nd._load_one(_pyio.BytesIO(blob))
+
+
+def random_seed(seed):
+    from . import random as _random
+    _random.seed(int(seed))
+    return True
+
+
+def executor_print(executor):
+    return executor.debug_str()
 
 
 def nd_wait_all():

@@ -78,6 +78,8 @@ thread_local std::vector<void *> tl_handles;
 thread_local std::string tl_json;
 thread_local std::string tl_record;   // RecordIO read buffer: must not
                                       // alias tl_json (symbol JSON API)
+thread_local std::string tl_raw;      // NDArray raw-bytes buffer: must
+                                      // not alias either of the above
 
 int StringList(PyObject *list, mx_uint *out_size, const char ***out_array) {
   Py_ssize_t n = PySequence_Size(list);
@@ -256,6 +258,43 @@ int MXNDArrayLoad(const char *fname, mx_uint *out_size,
   if (rc == 0) rc = StringList(names, out_name_size, out_names);
   Py_DECREF(pair);
   return rc;
+}
+
+int MXRandomSeed(int seed) {
+  MXTPUEnsurePython();
+  return Call("random_seed", nullptr, "(i)", seed);
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("nd_save_raw", &ret, "(O)", handle) != 0) return -1;
+  char *data = nullptr;
+  Py_ssize_t len = 0;
+  if (PyBytes_AsStringAndSize(ret, &data, &len) != 0) {
+    Py_DECREF(ret);
+    return MXTPUFail("MXNDArraySaveRawBytes");
+  }
+  tl_raw.assign(data, len);
+  *out_buf = tl_raw.data();
+  *out_size = static_cast<size_t>(len);
+  Py_DECREF(ret);
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  MXTPUEnsurePython();
+  MXTPUGil gil;
+  PyObject *blob = PyBytes_FromStringAndSize(
+      static_cast<const char *>(buf), size);
+  if (blob == nullptr) return MXTPUFail("MXNDArrayLoadFromRawBytes");
+  PyObject *ret = nullptr;
+  int rc = Call("nd_load_raw", &ret, "(N)", blob);
+  if (rc != 0) return -1;
+  *out = ret;
+  return 0;
 }
 
 int MXNDArraySlice(NDArrayHandle handle, mx_uint slice_begin,
@@ -634,6 +673,21 @@ int MXExecutorOutputs(ExecutorHandle exec, mx_uint *out_size,
   int rc = HandleList(lst, out_size, reinterpret_cast<void ***>(out));
   Py_DECREF(lst);
   return rc;
+}
+
+int MXExecutorPrint(ExecutorHandle exec, const char **out_str) {
+  MXTPUGil gil;
+  PyObject *ret = nullptr;
+  if (Call("executor_print", &ret, "(O)", exec) != 0) return -1;
+  const char *s = PyUnicode_AsUTF8(ret);
+  if (s == nullptr) {
+    Py_DECREF(ret);
+    return MXTPUFail("MXExecutorPrint");
+  }
+  tl_json = s;
+  Py_DECREF(ret);
+  *out_str = tl_json.c_str();
+  return 0;
 }
 
 int MXExecutorSetMonitorCallback(ExecutorHandle exec,

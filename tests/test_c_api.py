@@ -650,3 +650,73 @@ def test_c_ndarray_views_and_meta():
 
     for h in (s, at, r, a):
         lib.MXNDArrayFree(h)
+
+
+@pytest.mark.skipif(not os.path.exists(_LIB),
+                    reason="libmxtpu_c_api.so not built")
+def test_c_misc_raw_bytes_seed_print():
+    """MXNDArraySaveRawBytes/LoadFromRawBytes round-trip, MXRandomSeed,
+    MXExecutorPrint."""
+    lib = ctypes.CDLL(_LIB)
+    lib.MXGetLastError.restype = ctypes.c_char_p
+
+    def ok(rc):
+        assert rc == 0, lib.MXGetLastError()
+
+    ok(lib.MXRandomSeed(42))
+
+    shape = (ctypes.c_uint * 2)(2, 3)
+    a = ctypes.c_void_p()
+    ok(lib.MXNDArrayCreate(shape, 2, 1, 0, 0, ctypes.byref(a)))
+    xs = np.arange(6, dtype="f").reshape(2, 3)
+    ok(lib.MXNDArraySyncCopyFromCPU(
+        a, xs.ctypes.data_as(ctypes.c_void_p), xs.size))
+    size = ctypes.c_size_t()
+    buf = ctypes.c_void_p()
+    ok(lib.MXNDArraySaveRawBytes(a, ctypes.byref(size), ctypes.byref(buf)))
+    raw = ctypes.string_at(buf.value, size.value)
+    b = ctypes.c_void_p()
+    ok(lib.MXNDArrayLoadFromRawBytes(raw, len(raw), ctypes.byref(b)))
+    got = np.zeros((2, 3), "f")
+    ok(lib.MXNDArraySyncCopyToCPU(
+        b, got.ctypes.data_as(ctypes.c_void_p), got.size))
+    np.testing.assert_allclose(got, xs)
+
+    # executor print: bind a trivial graph, dump its debug string
+    data = ctypes.c_void_p()
+    ok(lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    n = ctypes.c_uint()
+    creators = ctypes.POINTER(ctypes.c_void_p)()
+    ok(lib.MXSymbolListAtomicSymbolCreators(ctypes.byref(n),
+                                            ctypes.byref(creators)))
+    name_p = ctypes.c_char_p()
+    fc_creator = None
+    for i in range(n.value):
+        ok(lib.MXSymbolGetAtomicSymbolName(ctypes.c_void_p(creators[i]),
+                                           ctypes.byref(name_p)))
+        if name_p.value == b"FullyConnected":
+            fc_creator = ctypes.c_void_p(creators[i])
+    assert fc_creator is not None
+    fc = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"num_hidden")
+    vals = (ctypes.c_char_p * 1)(b"3")
+    ok(lib.MXSymbolCreateAtomicSymbol(fc_creator, 1, keys, vals,
+                                      ctypes.byref(fc)))
+    arg_keys = (ctypes.c_char_p * 1)(b"data")
+    arg_vals = (ctypes.c_void_p * 1)(data)
+    ok(lib.MXSymbolCompose(fc, b"fc", 1, arg_keys, arg_vals))
+    exec_h = ctypes.c_void_p()
+    in_keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint * 2)(0, 2)
+    shape_data = (ctypes.c_uint * 2)(2, 4)
+    ok(lib.MXExecutorSimpleBind(fc, 1, 0, 1, in_keys, indptr, shape_data,
+                                b"write", ctypes.byref(exec_h)))
+    s = ctypes.c_char_p()
+    ok(lib.MXExecutorPrint(exec_h, ctypes.byref(s)))
+    assert b"fc" in s.value
+
+    lib.MXExecutorFree(exec_h)
+    lib.MXSymbolFree(fc)
+    lib.MXSymbolFree(data)
+    lib.MXNDArrayFree(a)
+    lib.MXNDArrayFree(b)
