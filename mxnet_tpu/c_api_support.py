@@ -79,15 +79,14 @@ def nd_at(handle, idx):
 
 
 def nd_reshape(handle, shape):
+    # eager size check via the ndarray layer's own -1-inference, so the
+    # C API and the python front end share one set of reshape rules
     shape = tuple(int(d) for d in shape)
-    known = int(np.prod([d for d in shape if d != -1]))
-    if shape.count(-1) > 1 or (shape.count(-1) == 0 and
-                               known != handle.size) or \
-            (shape.count(-1) == 1 and
-             (known == 0 or handle.size % known)):
+    filled = nd._fill_reshape(handle.shape, shape)
+    if shape.count(-1) > 1 or int(np.prod(filled)) != handle.size:
         raise MXNetError("cannot reshape %s array into %s"
-                         % (handle.shape, (shape,)))
-    return handle.reshape(shape)
+                         % (handle.shape, shape))
+    return handle.reshape(filled)
 
 
 def nd_dtype(handle):
@@ -121,6 +120,12 @@ def nd_load(fname):
 def nd_save_raw(handle):
     """Single-array chunk bytes (reference ``MXNDArraySaveRawBytes`` —
     the NDArray::Save chunk without the file container)."""
+    if handle.ndim == 0:
+        # the chunk format reserves ndim==0 for the reference's "none"
+        # array (shape only, no payload) — a data-bearing scalar would
+        # silently round-trip to zero
+        raise MXNetError("cannot serialize a 0-d NDArray as raw bytes; "
+                         "reshape to (1,) first")
     import io as _pyio
     buf = _pyio.BytesIO()
     nd._save_one(buf, handle)

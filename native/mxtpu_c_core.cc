@@ -80,6 +80,7 @@ thread_local std::string tl_record;   // RecordIO read buffer: must not
                                       // alias tl_json (symbol JSON API)
 thread_local std::string tl_raw;      // NDArray raw-bytes buffer: must
                                       // not alias either of the above
+thread_local std::string tl_debug;    // executor debug-string buffer
 
 int StringList(PyObject *list, mx_uint *out_size, const char ***out_array) {
   Py_ssize_t n = PySequence_Size(list);
@@ -684,9 +685,9 @@ int MXExecutorPrint(ExecutorHandle exec, const char **out_str) {
     Py_DECREF(ret);
     return MXTPUFail("MXExecutorPrint");
   }
-  tl_json = s;
+  tl_debug = s;
   Py_DECREF(ret);
-  *out_str = tl_json.c_str();
+  *out_str = tl_debug.c_str();
   return 0;
 }
 

@@ -127,6 +127,15 @@ def main():
             rtol=2e-4, atol=2e-5,
             err_msg="fused dist diverged from serial for %s" % name)
 
+    # (4) optimizer-state checkpoint roundtrip on the multi-host trainer
+    # (every rank calls in lockstep — the collective-read contract)
+    blob = mod._trainer.get_opt_states()
+    before = mod._trainer.num_update
+    mod._trainer.set_opt_states(blob)
+    assert mod._trainer.num_update == before
+    restored = mod._trainer.get_opt_states()
+    assert blob == restored, "opt state changed across save/load"
+
     it.reset()
     acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
     kv._barrier()
