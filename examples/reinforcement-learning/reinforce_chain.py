@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""REINFORCE policy gradient on a chain world (reference
+``example/reinforcement-learning`` — the policy-gradient pattern of its
+a3c/ddpg examples, with the environment and return bookkeeping
+host-side and the policy network trained through a bound executor).
+
+Environment: 1-d chain of N cells, agent starts in the middle, actions
+move left/right, reward 1.0 for reaching the right end within the step
+cap.  The policy must learn "go right".  Gradient: d(-log pi(a)) /
+d(logits) = (softmax(logits) - onehot(a)) * advantage, fed to
+``Executor.backward`` as the output cotangent — the classic MXNet
+policy-gradient recipe.
+
+Run: python examples/reinforcement-learning/reinforce_chain.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+N_CELLS = 8
+MAX_STEPS = 24
+GAMMA = 0.95
+
+
+def rollout(ex, rng, batch):
+    """Run ``batch`` episodes with the current policy; returns flat
+    (states, actions, discounted returns, successes)."""
+    states, actions, rewards = [], [], []
+    successes = 0
+    for _ in range(batch):
+        pos = N_CELLS // 2
+        ep_s, ep_a = [], []
+        success = False
+        for _ in range(MAX_STEPS):
+            s = np.zeros(N_CELLS, "f")
+            s[pos] = 1.0
+            ex.arg_dict["data"][:] = np.tile(s, (1, 1))
+            ex.forward(is_train=False)
+            logits = ex.outputs[0].asnumpy()[0]
+            p = np.exp(logits - logits.max())
+            p /= p.sum()
+            a = int(rng.rand() < p[1])          # 0 = left, 1 = right
+            ep_s.append(s)
+            ep_a.append(a)
+            pos = max(0, pos - 1) if a == 0 else pos + 1
+            if pos >= N_CELLS - 1:
+                success = True
+                break
+        successes += int(success)
+        # discounted return per visited state (terminal reward only)
+        R = 1.0 if success else 0.0
+        ep_r = []
+        for _ in reversed(ep_s):
+            ep_r.append(R)
+            R *= GAMMA
+        ep_r.reverse()
+        states.extend(ep_s)
+        actions.extend(ep_a)
+        rewards.extend(ep_r)
+    return (np.array(states, "f"), np.array(actions, np.int64),
+            np.array(rewards, "f"), successes)
+
+
+def main():
+    parser = argparse.ArgumentParser(description="REINFORCE chain world")
+    parser.add_argument("--iters", type=int, default=30)
+    parser.add_argument("--episodes", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=0.5)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    data = mx.sym.Variable("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="tanh")
+    logits = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+
+    # one executor per batch shape: 1 (acting) + training reshapes
+    act_ex = logits.simple_bind(mx.cpu(), data=(1, N_CELLS))
+    for name, arr in act_ex.arg_dict.items():
+        if name != "data":
+            arr[:] = rng.normal(0, 0.2, arr.shape)
+
+    # ONE training executor at a fixed padded batch (compile once);
+    # padded rows get zero cotangent, hence zero gradient
+    train_n = args.episodes * MAX_STEPS
+    ex = logits.bind(
+        mx.cpu(),
+        args={"data": mx.nd.zeros((train_n, N_CELLS)),
+              **{k: v for k, v in act_ex.arg_dict.items()
+                 if k != "data"}},
+        args_grad={k: mx.nd.zeros(v.shape)
+                   for k, v in act_ex.arg_dict.items()},
+        grad_req="write")
+
+    baseline = 0.0
+    for it in range(args.iters):
+        S, A, R, wins = rollout(act_ex, rng, args.episodes)
+        baseline = 0.9 * baseline + 0.1 * R.mean()
+        adv = R - baseline
+
+        n = len(A)
+        padded = np.zeros((train_n, N_CELLS), "f")
+        padded[:n] = S
+        ex.arg_dict["data"][:] = padded
+        ex.forward(is_train=True)
+        lg = ex.outputs[0].asnumpy()[:n]
+        p = np.exp(lg - lg.max(1, keepdims=True))
+        p /= p.sum(1, keepdims=True)
+        onehot = np.zeros_like(p)
+        onehot[np.arange(n), A] = 1.0
+        cot = np.zeros((train_n, 2), "f")
+        cot[:n] = (p - onehot) * adv[:, None] / n
+        ex.backward([mx.nd.array(cot)])
+        for name, arr in act_ex.arg_dict.items():
+            if name == "data":
+                continue
+            g = ex.grad_dict[name].asnumpy()
+            arr[:] = arr.asnumpy() - args.lr * g
+        if it % 5 == 0:
+            logging.info("iter %d: success %d/%d, mean return %.3f",
+                         it, wins, args.episodes, R.mean())
+
+    _, _, _, wins = rollout(act_ex, rng, args.episodes)
+    rate = wins / args.episodes
+    logging.info("final success rate: %.2f", rate)
+    return 0 if rate >= 0.9 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,11 @@ def nd_reshape(handle, shape):
     # eager size check via the ndarray layer's own -1-inference, so the
     # C API and the python front end share one set of reshape rules
     shape = tuple(int(d) for d in shape)
+    if shape.count(-1) == 1 and handle.size == 0:
+        # ambiguous: any -1 value satisfies 0*k == 0 (numpy/reference
+        # reject this too)
+        raise MXNetError("cannot infer -1 when reshaping a zero-size "
+                         "array (%s -> %s)" % (handle.shape, shape))
     filled = nd._fill_reshape(handle.shape, shape)
     if shape.count(-1) > 1 or int(np.prod(filled)) != handle.size:
         raise MXNetError("cannot reshape %s array into %s"
