@@ -98,7 +98,8 @@ def main():
               **{k: v for k, v in act_ex.arg_dict.items()
                  if k != "data"}},
         args_grad={k: mx.nd.zeros(v.shape)
-                   for k, v in act_ex.arg_dict.items()},
+                   for k, v in act_ex.arg_dict.items()
+                   if k != "data"},       # input grads are never read
         grad_req="write")
 
     baseline = 0.0

@@ -82,9 +82,10 @@ def nd_reshape(handle, shape):
     # eager size check via the ndarray layer's own -1-inference, so the
     # C API and the python front end share one set of reshape rules
     shape = tuple(int(d) for d in shape)
-    if shape.count(-1) == 1 and handle.size == 0:
-        # ambiguous: any -1 value satisfies 0*k == 0 (numpy/reference
-        # reject this too)
+    known_zero = any(d == 0 for d in shape)
+    if shape.count(-1) == 1 and handle.size == 0 and known_zero:
+        # genuinely ambiguous (0 * k == 0 for every k); with nonzero
+        # known dims the -1 resolves to 0, which numpy accepts
         raise MXNetError("cannot infer -1 when reshaping a zero-size "
                          "array (%s -> %s)" % (handle.shape, shape))
     filled = nd._fill_reshape(handle.shape, shape)
