@@ -1,8 +1,9 @@
 """Model zoo: Symbol builders for the reference's example networks.
 
 Mirrors the capability of ``example/image-classification/symbols/`` in the
-reference (mlp, lenet, alexnet, vgg, resnet, inception-bn, inception-v3)
-plus the bucketing LSTM language model (``example/rnn/lstm_bucketing.py``).
+reference (mlp, lenet, alexnet, vgg, resnet, resnext, googlenet,
+inception-bn, inception-v3, inception-resnet-v2) plus the bucketing LSTM
+language model (``example/rnn/lstm_bucketing.py``) and a transformer.
 Architectures are standard published networks, written fresh in
 mxnet_tpu Symbol idiom; the graphs compile to single XLA computations.
 
@@ -17,6 +18,7 @@ from . import vgg
 from . import resnet
 from . import inception_bn
 from . import inception_v3
+from . import inception_resnet_v2
 from . import googlenet
 from . import lstm_lm
 from . import resnext
@@ -24,7 +26,7 @@ from . import transformer
 
 __all__ = ["get_symbol", "mlp", "lenet", "alexnet", "vgg", "resnet",
            "resnext", "googlenet", "inception_bn", "inception_v3",
-           "lstm_lm", "transformer"]
+           "inception_resnet_v2", "lstm_lm", "transformer"]
 
 _BUILDERS = {
     "mlp": mlp.get_symbol,
@@ -33,6 +35,7 @@ _BUILDERS = {
     "googlenet": googlenet.get_symbol,
     "inception-bn": inception_bn.get_symbol,
     "inception-v3": inception_v3.get_symbol,
+    "inception-resnet-v2": inception_resnet_v2.get_symbol,
     "transformer": transformer.get_symbol,
     "gpt": transformer.get_symbol,
 }

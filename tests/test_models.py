@@ -18,6 +18,7 @@ from mxnet_tpu import models
     ("resnext-50", (2, 3, 224, 224)),
     ("inception-bn", (2, 3, 224, 224)),
     ("inception-v3", (2, 3, 299, 299)),
+    ("inception-resnet-v2", (2, 3, 299, 299)),
 ])
 def test_model_shapes(name, shape):
     sym = models.get_symbol(name, num_classes=10)
