@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Neural style transfer, toy-sized (reference ``example/neural-style``):
+optimize the INPUT image — not the weights — so that its deep features
+match a content image and its feature Gram matrices match a style
+image, through a Module bound with ``inputs_need_grad=True`` and a
+fixed random convnet (random-feature style transfer; Ulyanov et al.
+showed random encoders carry usable style statistics, and the machinery
+— per-layer feature taps, Gram losses, gradients w.r.t. data — is
+identical to the VGG recipe).
+
+Asserts the optimization works: both content and style losses must fall
+well below their starting values.
+
+Run: python examples/neural-style/neural_style_toy.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+SIZE = 48
+
+
+def feature_net():
+    """3-stage conv encoder; outputs every stage's features (the
+    relu1/relu2/relu3 taps of the VGG recipe)."""
+    data = mx.sym.Variable("data")
+    taps = []
+    body = data
+    for i, nf in enumerate((8, 16, 32)):
+        body = mx.sym.Convolution(body, kernel=(3, 3), pad=(1, 1),
+                                  num_filter=nf, name="conv%d" % i)
+        body = mx.sym.Activation(body, act_type="tanh")
+        taps.append(body)
+        if i < 2:
+            body = mx.sym.Pooling(body, kernel=(2, 2), stride=(2, 2),
+                                  pool_type="avg")
+    return mx.sym.Group(taps)
+
+
+def gram(feat):
+    """(C, C) Gram matrix of a (1, C, H, W) feature map."""
+    c = feat.shape[1]
+    f = feat.reshape(c, -1)
+    return (f @ f.T) / f.shape[1]
+
+
+def make_images(rng):
+    """Content: a bright diagonal square. Style: horizontal stripes."""
+    content = rng.normal(0, 0.05, (1, 3, SIZE, SIZE)).astype("f")
+    content[0, :, 12:36, 12:36] += 1.0
+    style = rng.normal(0, 0.05, (1, 3, SIZE, SIZE)).astype("f")
+    style[0, :, ::4, :] += 1.0
+    return content, style
+
+
+def main():
+    parser = argparse.ArgumentParser(description="toy neural style")
+    parser.add_argument("--iters", type=int, default=200)
+    parser.add_argument("--lr", type=float, default=0.1)
+    # style grams are tiny relative to raw feature distances (the
+    # reference's recipe likewise weights style orders of magnitude up)
+    parser.add_argument("--style-weight", type=float, default=2000.0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    mod = mx.mod.Module(feature_net(), label_names=None)
+    mod.bind(data_shapes=[("data", (1, 3, SIZE, SIZE))],
+             label_shapes=None, inputs_need_grad=True, for_training=True)
+    mod.init_params(mx.init.Xavier(magnitude=2.0))   # fixed random encoder
+
+    content_img, style_img = make_images(rng)
+
+    def features(img):
+        mod.forward(mx.io.DataBatch(data=[mx.nd.array(img)], label=[]),
+                    is_train=True)
+        return [o.asnumpy() for o in mod.get_outputs()]
+
+    content_feats = features(content_img)
+    style_grams = [gram(f) for f in features(style_img)]
+    # the meaningful style baseline: how far the CONTENT image's texture
+    # is from the style target (transfer = close that gap while keeping
+    # content)
+    style_baseline = sum(
+        0.25 * float(((gram(f) - sg) ** 2).sum())
+        for f, sg in zip(content_feats, style_grams))
+
+    # start from noise, descend on the input image
+    img = rng.normal(0, 0.3, content_img.shape).astype("f")
+    first = None
+    for it in range(args.iters):
+        feats = features(img)
+        # content: 0.5*||f - cf||^2 on the first tap only
+        closs = 0.5 * float(((feats[0] - content_feats[0]) ** 2).sum())
+        # gradients of the two losses w.r.t. each tapped feature map
+        out_grads = []
+        sloss = 0.0
+        for tap, (f, sg) in enumerate(zip(feats, style_grams)):
+            c, hw = f.shape[1], f.shape[2] * f.shape[3]
+            g_content = (f - content_feats[0]) if tap == 0 \
+                else np.zeros_like(f)
+            # style: 0.25*||G - G_s||^2 per tap; dL/df = (G - G_s) f / hw
+            diff = gram(f) - sg
+            sloss += 0.25 * float((diff ** 2).sum())
+            g_style = (diff @ f.reshape(c, -1)).reshape(f.shape) / hw
+            out_grads.append(mx.nd.array(
+                g_content + args.style_weight * g_style))
+        mod.backward(out_grads)
+        g = mod.get_input_grads()[0].asnumpy()
+        img -= args.lr * g
+        if first is None:
+            first = (closs, sloss)
+        if it % 30 == 0:
+            logging.info("iter %d content %.3f style %.3f", it, closs,
+                         sloss)
+
+    logging.info("content %.3f -> %.3f; style %.4f (content-image "
+                 "baseline %.4f)", first[0], closs, sloss, style_baseline)
+    # generous margins: the converged point is a content/style tradeoff
+    # equilibrium, not zero
+    ok = closs < 0.1 * first[0] and sloss < 0.7 * style_baseline
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,10 +98,10 @@ def _block8(net, idx, scale=0.2, act=True):
     return sym.Activation(out, act_type="relu") if act else out
 
 
-def get_symbol(num_classes=1000, blocks=(5, 10, 5), **kwargs):
+def get_symbol(num_classes=1000, blocks=(10, 20, 10), **kwargs):
     """Build Inception-ResNet-v2.  ``blocks`` counts the A/B/C residual
-    blocks (paper: 10/20/10; default here is the half-depth variant so
-    tests compile quickly — pass (10, 20, 10) for the paper network)."""
+    blocks; the default is the published 10/20/10 network (pass a
+    smaller tuple, e.g. ``(5, 10, 5)``, for quick tests)."""
     data = sym.Variable("data")
     net = _stem(data)
     for i in range(blocks[0]):
