@@ -93,6 +93,9 @@ def main():
         0.25 * float(((gram(f) - sg) ** 2).sum())
         for f, sg in zip(content_feats, style_grams))
 
+    if args.iters < 1:
+        logging.error("--iters must be >= 1")
+        return 2
     # start from noise, descend on the input image
     img = rng.normal(0, 0.3, content_img.shape).astype("f")
     first = None

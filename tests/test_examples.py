@@ -1,0 +1,48 @@
+"""Example-family smoke tests: the fast examples must run end-to-end
+and learn (exit 0) — the reference treated ``example/`` as its de-facto
+integration suite (SURVEY §2 layer 11), so regressions here are product
+regressions.  The slower families have dedicated tests (rcnn:
+test_rcnn.py) or run standalone (ssd, gan, long-context)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(relpath, *args, timeout=420):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    res = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "examples", relpath),
+         *args],
+        capture_output=True, text=True, timeout=timeout, cwd=_ROOT,
+        env=env)
+    assert res.returncode == 0, \
+        "%s failed:\n%s\n%s" % (relpath, res.stdout[-2000:],
+                                res.stderr[-2000:])
+
+
+def test_numpy_ops_example():
+    _run_example("numpy-ops/numpy_softmax.py")
+
+
+def test_adversary_example():
+    _run_example("adversary/fgsm_toy.py")
+
+
+def test_text_cnn_example():
+    _run_example("cnn_text_classification/train_text_cnn_toy.py",
+                 "--num-epoch", "8")
+
+
+def test_autoencoder_example():
+    _run_example("autoencoder/train_autoencoder_toy.py",
+                 "--pretrain-epoch", "6", "--finetune-epoch", "10")
+
+
+def test_neural_style_example():
+    _run_example("neural-style/neural_style_toy.py")
