@@ -19,8 +19,10 @@ from mxnet_tpu import models
 logging.basicConfig(level=logging.INFO)
 
 
-def score(network, batch_size, image_shape=(3, 224, 224), num_batches=10,
+def score(network, batch_size, image_shape=(3, 224, 224), num_batches=50,
           dtype="float32"):
+    # enough batches that per-dispatch tunnel jitter (~3 ms) and the
+    # tail sync latency are <5% of the timed region
     sym = models.get_symbol(network, num_classes=1000)
     data_shape = (batch_size,) + image_shape
     mod = mx.mod.Module(symbol=sym, context=mx.tpu())
@@ -37,7 +39,7 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=10,
         # no-op on remote TPU backends)
         np.asarray(mod.get_outputs()[0].data[:1, :1])
 
-    for _ in range(2):                       # compile + warmup
+    for _ in range(10):                      # compile + pipeline warmup
         mod.forward(batch, is_train=False)
     sync()
     tic = time.time()

@@ -70,6 +70,9 @@ def main():
     parser.add_argument("--style-weight", type=float, default=2000.0)
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
+    if args.iters < 1:
+        logging.error("--iters must be >= 1")
+        return 2
     rng = np.random.RandomState(0)
 
     mod = mx.mod.Module(feature_net(), label_names=None)
@@ -93,9 +96,6 @@ def main():
         0.25 * float(((gram(f) - sg) ** 2).sum())
         for f, sg in zip(content_feats, style_grams))
 
-    if args.iters < 1:
-        logging.error("--iters must be >= 1")
-        return 2
     # start from noise, descend on the input image
     img = rng.normal(0, 0.3, content_img.shape).astype("f")
     first = None

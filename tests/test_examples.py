@@ -7,8 +7,6 @@ import os
 import subprocess
 import sys
 
-import pytest
-
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
