@@ -124,3 +124,69 @@ def test_lr_schedulers():
     assert ms(0) == 1.0
     assert abs(ms(6) - 0.1) < 1e-12
     assert abs(ms(16) - 0.01) < 1e-12
+
+
+def test_log_train_metric_and_progressbar(caplog, capsys):
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1.0])], [mx.nd.array([[0.1, 0.9]])])
+
+    class P:
+        def __init__(self, i):
+            self.epoch, self.nbatch, self.eval_metric = 0, i, metric
+
+    cb = mx.callback.log_train_metric(period=2, auto_reset=True)
+    with caplog.at_level(logging.INFO):
+        cb(P(1))                       # not due
+        cb(P(2))                       # due; also resets
+    assert any("Train-accuracy" in r.message for r in caplog.records)
+    assert metric.num_inst == 0        # auto_reset cleared the metric
+
+    bar = mx.callback.ProgressBar(total=4, length=8)
+    bar(P(2))
+    out = capsys.readouterr().out
+    assert "[====----]" in out and "50%" in out
+
+
+def test_poly_scheduler_and_rewind_speedometer(caplog):
+    ps = mx.lr_scheduler.PolyScheduler(max_update=10, power=2)
+    ps.base_lr = 1.0
+    assert ps(0) == 1.0
+    assert abs(ps(5) - 0.25) < 1e-12
+    assert ps(10) == 0.0 and ps(15) == 0.0
+
+    # Speedometer re-arms when the batch counter rewinds (a new epoch)
+    sp = mx.callback.Speedometer(batch_size=4, frequent=2)
+
+    class P:
+        def __init__(self, i):
+            self.epoch, self.nbatch, self.eval_metric = 0, i, None
+
+    with caplog.at_level(logging.INFO):
+        for i in (1, 2, 3, 4):
+            sp(P(i))
+        n_before = sum("Speed" in r.message for r in caplog.records)
+        sp(P(1))                      # rewind: re-arms, must NOT log a
+        n_rewind = sum("Speed" in r.message for r in caplog.records)
+        for i in (2, 3, 4):           # window refills from batch 1
+            sp(P(i))
+        n_after = sum("Speed" in r.message for r in caplog.records)
+    assert n_before >= 1
+    assert n_rewind == n_before       # no epoch-spanning window logged
+    assert n_after > n_before
+
+
+def test_monitor_toc_print_and_sort(caplog):
+    mon = mx.monitor.Monitor(1, pattern=".*", sort=True)
+    X, Y = _tiny_data()
+    it = mx.io.NDArrayIter(X, Y, batch_size=16)
+    mod = mx.mod.Module(_tiny_net())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(next(iter(it)), is_train=True)
+    with caplog.at_level(logging.INFO):
+        mon.toc_print()
+    names = [r.message.split()[2] for r in caplog.records
+             if r.message.startswith("Batch:")]
+    assert names == sorted(names) and len(names) > 2
