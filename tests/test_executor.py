@@ -1,5 +1,6 @@
 """Executor tests (reference ``tests/python/unittest/test_executor.py``)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 
@@ -121,4 +122,30 @@ def test_partial_forward():
         left = ex.partial_forward(is_train=False, step=step)
         steps += 1
     assert steps == 3            # fc, tanh, fc2
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), want, rtol=1e-6)
+
+
+def test_partial_forward_ordering_and_invalidation():
+    """Out-of-order steps raise; a full forward() supersedes an
+    in-flight partial sequence (no stale mixed-state outputs)."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    net = mx.sym.Activation(net, act_type="relu")
+    rng = np.random.RandomState(1)
+    args = {"data": mx.nd.array(rng.randn(2, 4).astype("f")),
+            "fc_weight": mx.nd.array(rng.randn(3, 4).astype("f")),
+            "fc_bias": mx.nd.zeros((3,))}
+    ex = net.bind(mx.cpu(), args=args)
+
+    # steps must be issued in order from 0
+    with pytest.raises(Exception):
+        ex.partial_forward(is_train=False, step=1)
+
+    # start a partial run, then interrupt it with a full forward on new
+    # data; the old sequence must not resume silently
+    ex.partial_forward(is_train=False, step=0)
+    args["data"][:] = rng.randn(2, 4).astype("f")
+    want = ex.forward(is_train=False)[0].asnumpy()
+    with pytest.raises(Exception):
+        ex.partial_forward(is_train=False, step=1)   # stale sequence gone
     np.testing.assert_allclose(ex.outputs[0].asnumpy(), want, rtol=1e-6)
