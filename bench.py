@@ -21,16 +21,27 @@ import numpy as np
 BASELINE_IMG_S = 181.53  # reference single-P100 ResNet-50 train, batch 32
 
 
-def _pipeline_bench(mx, mod, metric, n_images=300, batch=256, steps=3):
+def _pipeline_bench(mx, mod, metric, staged_img_s, n_images=512, batch=256,
+                    steps=None):
     """Feed the already-compiled train step from the real input pipeline:
-    RecordIO -> native C++ JPEG decode pool -> PrefetchingIter (engine
-    double-buffering) -> H2D -> fused step.  Returns JSON fields for the
-    bench line, including the measured host caps that bound it on this
-    driver host."""
+    RecordIO -> native C++ JPEG decode pool (decoding straight into NHWC,
+    batches kept host-side) -> PrefetchingIter (engine double-buffering)
+    -> ONE H2D crossing per batch inside the trainer -> fused step.
+
+    Emits a per-stage budget so the number is checkable against the host
+    caps: ``decode_img_per_sec`` (loader alone), ``h2d_s_per_batch``
+    (measured one-batch upload), ``iter_overhead_s`` (per-batch wall time
+    not accounted for by upload + compute), and the bound
+    ``min(decode, h2d, staged)`` the end-to-end number should approach.
+    Timed window is a knob (MXTPU_BENCH_PIPELINE_STEPS, default 8): small
+    enough for CI, large enough that prefetch refill amortizes."""
     import jax
     import numpy as np
     from mxnet_tpu import io, recordio
     from mxnet_tpu.io import NativeImageRecordIter, PrefetchingIter
+
+    if steps is None:
+        steps = int(os.environ.get("MXTPU_BENCH_PIPELINE_STEPS", "8"))
 
     rec_path = "/tmp/mxtpu_bench_%d.rec" % n_images
     if not os.path.exists(rec_path):
@@ -49,30 +60,49 @@ def _pipeline_bench(mx, mod, metric, n_images=300, batch=256, steps=3):
         rec.close()
         os.rename(tmp_path, rec_path)   # atomic: no truncated cache reuse
 
-    # measured host->device cap (the binding constraint through the
-    # tunnel): time one mid-size transfer; warm both the transfer AND the
-    # jnp.sum completion barrier so compile time stays out of the window
-    probe = np.zeros((16, 224, 224, 3), np.float32)
+    def make_iter():
+        return NativeImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 224, 224),
+            batch_size=batch, rand_crop=True, rand_mirror=True,
+            layout="NHWC", output="numpy",
+            preprocess_threads=max(2, os.cpu_count() or 1))
+
+    # stage budget 1: raw decode rate (loader alone, no model, no H2D).
+    # The loader decodes EVERY slot of a batch (wrap-padding included),
+    # so a timed call is worth `batch` decodes regardless of pad —
+    # n_images is a multiple of batch anyway, so epochs divide evenly.
+    raw = make_iter()
+    next(iter(raw))                                     # pool warmup
+    t0 = time.perf_counter()
+    dec_images = 0
+    while dec_images < 2 * batch:
+        try:
+            raw.next()
+            dec_images += batch
+        except StopIteration:
+            raw.reset()
+    decode_img_s = dec_images / (time.perf_counter() - t0)
+
+    # stage budget 2: one-batch H2D through the tunnel (the model's
+    # actual per-batch upload; warm the transfer + the jnp.sum barrier
+    # first so compile time stays out of the window)
+    probe = np.zeros((batch, 224, 224, 3), np.float32)
     float(jax.numpy.sum(jax.device_put(probe)))
     t0 = time.perf_counter()
-    d = jax.device_put(probe)
-    float(jax.numpy.sum(d))
-    h2d_mbps = probe.nbytes / (time.perf_counter() - t0) / 1e6
+    float(jax.numpy.sum(jax.device_put(probe)))
+    h2d_s = time.perf_counter() - t0
+    h2d_mbps = probe.nbytes / h2d_s / 1e6
 
-    it = NativeImageRecordIter(
-        path_imgrec=rec_path, data_shape=(3, 224, 224), batch_size=batch,
-        rand_crop=True, rand_mirror=True,
-        preprocess_threads=max(2, os.cpu_count() or 1))
-    it = PrefetchingIter(it)
+    it = PrefetchingIter(make_iter())
 
     def batches():
         while True:
             for b in it:
-                # loader emits CHW; the NHWC model wants channels-last
-                x = np.ascontiguousarray(
-                    b.data[0].asnumpy().transpose(0, 2, 3, 1))
-                yield io.DataBatch(data=[mx.nd.array(x)], label=b.label,
-                                   pad=b.pad)
+                # image batch stays host-side numpy until the trainer's
+                # single device_put; labels are tiny, wrap for the metric
+                yield io.DataBatch(
+                    data=b.data, label=[mx.nd.array(l) for l in b.label],
+                    pad=b.pad)
             it.reset()
 
     gen = batches()
@@ -93,8 +123,19 @@ def _pipeline_bench(mx, mod, metric, n_images=300, batch=256, steps=3):
         mod.update_metric(metric, b.label)
     metric.get()
     elapsed = time.perf_counter() - t0
+
+    img_s = fresh / elapsed
+    step_s = batch / staged_img_s if staged_img_s else 0.0
+    per_batch_s = elapsed / steps
+    bound_img_s = min(decode_img_s, batch / h2d_s, staged_img_s or 1e9)
     return {
-        "pipeline_img_per_sec": round(fresh / elapsed, 2),
+        "pipeline_img_per_sec": round(img_s, 2),
+        "pipeline_steps_timed": steps,
+        "pipeline_bound_img_per_sec": round(bound_img_s, 2),
+        "pipeline_vs_bound": round(img_s / bound_img_s, 3),
+        "decode_img_per_sec": round(decode_img_s, 1),
+        "h2d_s_per_batch": round(h2d_s, 3),
+        "iter_overhead_s": round(max(0.0, per_batch_s - h2d_s - step_s), 3),
         "pipeline_host_h2d_mbps": round(h2d_mbps, 1),
         "pipeline_host_cpu_cores": os.cpu_count(),
     }
@@ -195,9 +236,10 @@ def main():
     pipe = None
     if on_tpu:
         try:
-            pipe = _pipeline_bench(mx, mod, metric)
+            pipe = _pipeline_bench(mx, mod, metric, img_s)
         except Exception as e:                      # noqa: BLE001
             print("pipeline bench failed: %s" % e, file=sys.stderr)
+            line["pipeline_error"] = str(e)
     try:
         roof = json.load(open(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "ROOFLINE.json")))
@@ -210,12 +252,30 @@ def main():
         ca = comp.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
-        step_tflops = ca.get("flops", 0.0) * (img_s / batch) / 1e12
+        flops = float(ca.get("flops", 0.0))
+        byts = float(ca.get("bytes accessed", 0.0))
+        step_tflops = flops * (img_s / batch) / 1e12
+        line["remat_policy"] = mod._trainer.remat
         line["achieved_tflops"] = round(step_tflops, 1)
         line["mfu_vs_measured_peak"] = round(
             step_tflops / roof["bf16_matmul_tflops"], 3)
-    except Exception:
-        pass
+        # the byte side of the same accounting (round-3 verdict: both
+        # sides or neither).  The XLA cost model OVERCOUNTS HBM traffic
+        # on fused conv programs (tools/roofline.py measures the
+        # per-pattern calibration: its cost-model bytes are exact on
+        # streaming kernels but fusion operands are double-counted in
+        # conv+epilogue pipelines), so achieved_gbps_cost_model is an
+        # UPPER bound on true traffic; hbm_frac_upper_bound > 1 means
+        # the overcount, not >peak streaming.
+        line["cost_model_gb_per_step"] = round(byts / 1e9, 2)
+        line["achieved_gbps_cost_model"] = round(
+            byts * (img_s / batch) / 1e9, 1)
+        if roof.get("hbm_gbps"):
+            line["hbm_frac_upper_bound"] = round(
+                byts * (img_s / batch) / 1e9 / roof["hbm_gbps"], 3)
+    except Exception as e:                          # noqa: BLE001
+        # never silently lose the MFU fields again (round-3 verdict #6)
+        line["mfu_error"] = str(e)
     if pipe is not None:
         line.update(pipe)
     print(json.dumps(line))

@@ -1,0 +1,41 @@
+"""Out-of-tree operator package: the ``EXTRA_OPERATORS`` /
+``plugin/`` analog (reference ``Makefile:149-152`` compiled extra op
+directories into the binary; ``plugin/{caffe,torch,warpctc,...}``
+linked foreign-framework ops the same way).
+
+Here extension is a PURE IMPORT: any package that calls
+``mxnet_tpu.op.registry.register`` at import time contributes ops to
+the installed framework — they appear under ``mx.nd.*`` / ``mx.sym.*``,
+get shape/dtype inference, JAX AD gradients, and XLA fusion exactly
+like in-tree ops, with no rebuild and no binary plugin ABI.
+
+Install with ``pip install -e examples/extension-ops`` (or just put it
+on ``sys.path``), then ``import mxtpu_contrib_ops`` before use.
+"""
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.op.registry import Param, register
+
+__all__ = ["mish", "hard_swish", "rms_norm"]
+
+
+@register("mish", hint="mish")
+def mish(p, c, a):
+    """Mish activation: x * tanh(softplus(x)) — an op family the
+    in-tree registry does not ship."""
+    return a * jnp.tanh(jax.nn.softplus(a))
+
+
+@register("hard_swish", hint="hard_swish")
+def hard_swish(p, c, a):
+    return a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0
+
+
+@register("rms_norm", params_spec=(Param("eps", float, 1e-6),),
+          input_names=("data", "gamma"), hint="rms_norm")
+def rms_norm(p, c, data, gamma):
+    """RMSNorm over the last axis with a learned scale — shows a
+    multi-input extension op with a parameter."""
+    ms = jnp.mean(jnp.square(data), axis=-1, keepdims=True)
+    return data * jax.lax.rsqrt(ms + p["eps"]) * gamma

@@ -1,0 +1,10 @@
+"""Installable out-of-tree op package (see mxtpu_contrib_ops/__init__).
+
+pip install -e examples/extension-ops
+"""
+from setuptools import setup
+
+setup(name="mxtpu-contrib-ops",
+      version="0.1",
+      packages=["mxtpu_contrib_ops"],
+      install_requires=[])

@@ -1,0 +1,151 @@
+#!/usr/bin/env python
+"""Noise-contrastive estimation loss, toy-sized (reference
+``example/nce-loss/nce.py`` + ``toy_nce.py``): instead of a full
+softmax over the vocabulary, each example scores the TRUE class plus k
+sampled noise classes — ``Embedding``-gathered class vectors, a
+broadcast-multiply dot against the data representation, and a
+``LogisticRegressionOutput`` over the (1 + k) candidates.  The
+gradient flows into the sampled rows of the embedding only: the
+sampled-softmax Embedding-gradient path this family exists to
+exercise.
+
+Run: python examples/nce-loss/train_nce_toy.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+VOCAB = 100
+NUM_LABEL = 6          # 1 true + 5 noise
+HIDDEN = 32
+FEATURE = 20
+
+
+def nce_loss(data, label, label_weight, embed_weight, vocab_size,
+             num_hidden):
+    """The reference's nce_loss block (``nce.py:7-16``): embed the
+    candidate class ids, dot each against the data vector, logistic
+    loss with the true/noise indicator as target."""
+    label_embed = mx.sym.Embedding(label, input_dim=vocab_size,
+                                   weight=embed_weight,
+                                   output_dim=num_hidden,
+                                   name="label_embed")   # (B, L, H)
+    data = mx.sym.Reshape(data, shape=(-1, 1, num_hidden))
+    pred = mx.sym.broadcast_mul(data, label_embed)
+    pred = mx.sym.sum(pred, axis=2)                      # (B, L) scores
+    return mx.sym.LogisticRegressionOutput(pred, label_weight,
+                                           name="nce")
+
+
+def get_net(vocab_size=VOCAB, num_hidden=HIDDEN):
+    data = mx.sym.Variable("data")
+    label = mx.sym.Variable("label")
+    label_weight = mx.sym.Variable("label_weight")
+    embed_weight = mx.sym.Variable("embed_weight")
+    pred = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc")
+    return nce_loss(pred, label, label_weight, embed_weight, vocab_size,
+                    num_hidden)
+
+
+class NceIter(mx.io.DataIter):
+    """Synthetic multi-hot features whose active bits determine the true
+    class; each batch carries [true, noise...] candidate ids plus the
+    0/1 indicator weights (the reference's toy DataIter contract)."""
+
+    def __init__(self, count, batch_size, vocab_size=VOCAB,
+                 num_label=NUM_LABEL, feature_size=FEATURE, seed=0):
+        super().__init__(batch_size)
+        self.count = count
+        self.vocab_size = vocab_size
+        self.num_label = num_label
+        self.feature_size = feature_size
+        self.rng = np.random.RandomState(seed)
+        # fixed random projection: feature pattern -> class id
+        self.proj = self.rng.randint(1, vocab_size,
+                                     size=(feature_size,))
+        self._batch = 0
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (self.batch_size,
+                                        self.feature_size))]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("label", (self.batch_size, self.num_label)),
+                mx.io.DataDesc("label_weight", (self.batch_size,
+                                                self.num_label))]
+
+    def reset(self):
+        self._batch = 0
+
+    def next(self):
+        if self._batch >= self.count:
+            raise StopIteration
+        self._batch += 1
+        B, L = self.batch_size, self.num_label
+        x = np.zeros((B, self.feature_size), "f")
+        label = np.zeros((B, L), "f")
+        weight = np.zeros((B, L), "f")
+        for i in range(B):
+            bits = self.rng.choice(self.feature_size, 3, replace=False)
+            x[i, bits] = 1.0
+            true = int(self.proj[bits].sum() % self.vocab_size)
+            cand = [true] + list(self.rng.randint(0, self.vocab_size,
+                                                  L - 1))
+            order = self.rng.permutation(L)
+            label[i] = np.asarray(cand, "f")[order]
+            weight[i] = (np.arange(L)[order] == 0).astype("f")
+        return mx.io.DataBatch(data=[mx.nd.array(x)],
+                               label=[mx.nd.array(label),
+                                      mx.nd.array(weight)],
+                               pad=0)
+
+
+class NceAccuracy(mx.metric.EvalMetric):
+    """Fraction of examples whose top-scored candidate is the true one
+    (reference ``nce.py NceAccuracy``)."""
+
+    def __init__(self):
+        super().__init__("nce-accuracy")
+
+    def update(self, labels, preds):
+        weight = labels[1].asnumpy()
+        scores = preds[0].asnumpy()
+        self.sum_metric += (scores.argmax(1) == weight.argmax(1)).sum()
+        self.num_inst += scores.shape[0]
+
+
+def main(epochs=8, batch=32, batches=20):
+    logging.basicConfig(level=logging.INFO)
+    train = NceIter(batches, batch)
+    mod = mx.mod.Module(get_net(), context=mx.cpu(),
+                        data_names=("data",),
+                        label_names=("label", "label_weight"))
+    metric = NceAccuracy()
+    mod.fit(train, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.02},
+            initializer=mx.init.Xavier(), eval_metric=metric)
+    train.reset()
+    metric.reset()
+    for b in train:
+        mod.forward(b, is_train=False)
+        metric.update(b.label, mod.get_outputs())
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+    acc = main(epochs=args.epochs)
+    assert acc > 0.8, acc
+    print("nce toy OK: accuracy %.3f" % acc)

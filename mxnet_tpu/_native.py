@@ -113,6 +113,7 @@ def _dl_declare(lib):
                                     c.POINTER(c.c_float),
                                     c.POINTER(c.c_float)]
     lib.mxt_loader_free.argtypes = [c.c_void_p]
+    lib.mxt_loader_set_layout.argtypes = [c.c_void_p, c.c_int]
     return lib
 
 

@@ -355,16 +355,24 @@ def io_iter_reset(it):
     return True
 
 
+def _c_current_batch(it):
+    batch = getattr(it, "_c_batch", None)
+    if batch is None:
+        raise MXNetError("no current batch: call MXDataIterNext first "
+                         "(or the iterator is exhausted)")
+    return batch
+
+
 def io_iter_data(it):
-    return it._c_batch.data[0]
+    return _c_current_batch(it).data[0]
 
 
 def io_iter_label(it):
-    return it._c_batch.label[0]
+    return _c_current_batch(it).label[0]
 
 
 def io_iter_pad(it):
-    return int(it._c_batch.pad or 0)
+    return int(_c_current_batch(it).pad or 0)
 
 
 # ----------------------------------------------------------------------

@@ -163,6 +163,7 @@ class Executor:
         self._vjp = None
         self._monitor = None
         self._partial = None      # partial_forward's carried env
+        self._partial_done = False  # a sequence ran to completion
         self._rng_counter = 0
 
     @property
@@ -179,6 +180,21 @@ class Executor:
         if self._prog.has_rng:
             return _random.next_key()
         return jax.random.key(0)
+
+    def _eager_committed(self, vals):
+        """Pin values for the eager per-node paths (monitor, partial
+        forward).  Bound arrays can be UNCOMMITTED — allocated on the
+        host while another platform is the jax default.  The jitted
+        paths still execute where the arrays live, but eager ops on
+        uncommitted inputs dispatch to the DEFAULT platform, silently
+        changing matmul precision when that default is a TPU; committing
+        the inputs keeps eager evaluation numerically identical to the
+        compiled path."""
+        try:
+            dev = list(self.arg_arrays[0].data.devices())[0]
+        except Exception:
+            return list(vals)
+        return [jax.device_put(v, dev) for v in vals]
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -199,7 +215,7 @@ class Executor:
                 pass
 
         self._partial = None      # a full forward supersedes any
-                                  # in-flight partial sequence
+        self._partial_done = False  # in-flight or completed partial sequence
         from . import profiler as _prof
         if self._monitor is not None:
             # per-op tapped evaluation (runs the forward once eagerly to
@@ -209,7 +225,8 @@ class Executor:
             def cb(name, val):
                 self._monitor(name, NDArray(val))
             outs, new_aux = self._prog._eval(
-                list(arg_vals), list(aux_vals), key, is_train, monitor=cb)
+                self._eager_committed(arg_vals),
+                self._eager_committed(aux_vals), key, is_train, monitor=cb)
             self._vjp = None
         if is_train:
             with _prof.record_scope("Forward", str(self._ctx)):
@@ -237,21 +254,34 @@ class Executor:
         prog = self._prog
         compute = [n for n in prog.nodes if not n.is_variable]
         if step >= len(compute):
-            return 0
+            # "done" is only a valid answer right after a sequence ran to
+            # completion; a cold or mid-sequence out-of-range step is the
+            # same ordering error as any other out-of-order call (the
+            # caller would otherwise read stale/empty outputs)
+            if not compute or (step > 0 and self._partial is None
+                               and self._partial_done):
+                return 0
+            raise MXNetError(
+                "partial_forward steps must be issued in order from 0 "
+                "(expected step %d, got %d)"
+                % (self._partial[3] if self._partial else 0, step))
         if step == 0:
-            env = {}
-            for n in prog.nodes:
-                if n.is_variable:
-                    env[(id(n), 0)] = self.arg_dict[n.name].data
-            self._partial = (env, [a.data for a in self.aux_arrays],
-                             self._next_key(), 0)
+            self._partial_done = False
+            var_nodes = [n for n in prog.nodes if n.is_variable]
+            var_vals = self._eager_committed(
+                [self.arg_dict[n.name].data for n in var_nodes])
+            env = {(id(n), 0): v for n, v in zip(var_nodes, var_vals)}
+            self._partial = (
+                env,
+                self._eager_committed([a.data for a in self.aux_arrays]),
+                self._next_key(), 0)
         if self._partial is None or self._partial[3] != step:
             raise MXNetError(
                 "partial_forward steps must be issued in order from 0 "
                 "(expected step %s, got %d)"
                 % (self._partial[3] if self._partial else 0, step))
         env, aux_out, key, _ = self._partial
-        aux_vals = [a.data for a in self.aux_arrays]
+        aux_vals = self._eager_committed([a.data for a in self.aux_arrays])
         prog._eval_node(compute[step], env, aux_vals, aux_out, key,
                         is_train, monitor=None)
         left = len(compute) - step - 1
@@ -261,6 +291,7 @@ class Executor:
             self._outputs = [NDArray(env[(id(nd), i)])
                              for nd, i in prog.output_entries]
             self._partial = None
+            self._partial_done = True
             self._vjp = None     # outputs no longer match any pullback
         else:
             self._partial = (env, aux_out, key, step + 1)

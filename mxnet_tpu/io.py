@@ -810,7 +810,8 @@ class NativeImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, scale=1.0, resize=-1, preprocess_threads=4,
                  part_index=0, num_parts=1, seed=0, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", layout="NCHW",
+                 output="ndarray", **kwargs):
         super().__init__(int(batch_size))
         from ._native import dataloader_lib
         import ctypes
@@ -818,6 +819,21 @@ class NativeImageRecordIter(DataIter):
         assert self._lib is not None, "native data loader unavailable"
         self.data_shape = _as_shape(data_shape)
         assert len(self.data_shape) == 3
+        # layout: "NCHW" (reference default) or "NHWC" (TPU-native; the
+        # C++ loop decodes channels-innermost, no host transpose).
+        # data_shape stays (C, H, W) in BOTH cases, like the reference's
+        # parameter contract; only the emitted batch layout changes.
+        if layout not in ("NCHW", "NHWC"):
+            raise MXNetError("layout must be NCHW or NHWC, got %r" % layout)
+        self.layout = layout
+        # output: "ndarray" uploads each batch to the default device;
+        # "numpy" keeps batches host-side so a host-feeding consumer
+        # (e.g. a sharded trainer doing its own device_put) pays exactly
+        # one H2D crossing per batch
+        if output not in ("ndarray", "numpy"):
+            raise MXNetError("output must be ndarray or numpy, got %r"
+                             % output)
+        self.output = output
         self.label_width = int(label_width)
         if self.label_width < 1:
             raise MXNetError("label_width must be >= 1")
@@ -837,12 +853,20 @@ class NativeImageRecordIter(DataIter):
             int(part_index), int(num_parts))
         if not self._handle:
             raise MXNetError("cannot open record file %s" % path_imgrec)
+        if self.layout == "NHWC":
+            self._lib.mxt_loader_set_layout(self._handle, 1)
         self.num_samples = int(self._lib.mxt_loader_count(self._handle))
 
     @property
+    def _batch_data_shape(self):
+        c, h, w = self.data_shape
+        if self.layout == "NHWC":
+            return (self.batch_size, h, w, c)
+        return (self.batch_size, c, h, w)
+
+    @property
     def provide_data(self):
-        return [DataDesc(self.data_name,
-                         (self.batch_size,) + self.data_shape)]
+        return [DataDesc(self.data_name, self._batch_data_shape)]
 
     @property
     def provide_label(self):
@@ -855,8 +879,7 @@ class NativeImageRecordIter(DataIter):
 
     def next(self):
         import ctypes
-        c, h, w = self.data_shape
-        data = np.empty((self.batch_size, c, h, w), np.float32)
+        data = np.empty(self._batch_data_shape, np.float32)
         label = np.empty((self.batch_size, self.label_width), np.float32)
         fresh = self._lib.mxt_loader_next(
             self._handle,
@@ -866,6 +889,9 @@ class NativeImageRecordIter(DataIter):
             raise StopIteration
         if self.label_width == 1:
             label = label.reshape(self.batch_size)
+        if self.output == "numpy":
+            return DataBatch(data=[data], label=[label],
+                             pad=self.batch_size - fresh)
         return DataBatch(data=[array(data)], label=[array(label)],
                          pad=self.batch_size - fresh)
 

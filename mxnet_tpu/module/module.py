@@ -387,8 +387,27 @@ class Module(BaseModule):
             self.logger.warning("optimizer already initialized, ignoring...")
             return
 
+        # Resolve a dist kvstore FIRST: the default rescale_grad must be
+        # computed over the GLOBAL batch (reference module.py:460-486 does
+        # ``batch_size *= kvstore.num_workers`` for dist_sync).  Both sync
+        # paths here sum gradients across hosts (the fused step psums; the
+        # classic kvstore _merge sums), so a local-batch default would
+        # scale the effective LR by num_workers on multi-host runs.
+        from ..kvstore import KVStore as _KVStore
+        from ..kvstore import create as _kv_create
+        if isinstance(kvstore, _KVStore):
+            kv = kvstore
+        elif isinstance(kvstore, str) and "dist" in kvstore:
+            kv = _kv_create(kvstore)
+        else:
+            kv = None
+        kvstore = kv if kv is not None else kvstore
+
+        batch_size = self._data_shapes[0].shape[0]
+        if kv is not None and "dist" in kv.type and "_async" not in kv.type:
+            batch_size *= kv.num_workers
+
         if isinstance(optimizer, str):
-            batch_size = self._data_shapes[0].shape[0]
             idx2name = {i: n for i, n in enumerate(self._param_names)}
             optimizer_params = dict(optimizer_params)
             if "rescale_grad" not in optimizer_params:
@@ -397,6 +416,12 @@ class Module(BaseModule):
                                    param_idx2name=idx2name, **optimizer_params)
         else:
             assert isinstance(optimizer, opt.Optimizer)
+            if optimizer.rescale_grad != 1.0 / batch_size:
+                self.logger.warning(
+                    "optimizer.rescale_grad is %g but 1/(global batch) is "
+                    "%g; gradients are summed over the global batch of %d "
+                    "— make sure this is intended",
+                    optimizer.rescale_grad, 1.0 / batch_size, batch_size)
             if not optimizer.idx2name:
                 optimizer.idx2name = {i: n for i, n in
                                       enumerate(self._param_names)}
@@ -404,15 +429,7 @@ class Module(BaseModule):
         self._optimizer = optimizer
 
         if self._mesh is not None and self._exec_group is None:
-            from ..kvstore import KVStore as _KVStore
-            from ..kvstore import create as _kv_create
             from ..parallel.optim import _supports_fusion
-            if isinstance(kvstore, _KVStore):
-                kv = kvstore
-            elif isinstance(kvstore, str) and "dist" in kvstore:
-                kv = _kv_create(kvstore)
-            else:
-                kv = None
             fallback = None
             if (kv is not None and "dist" in kv.type and
                     kv.num_workers > 1 and self._auto_fused):

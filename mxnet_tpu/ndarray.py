@@ -86,6 +86,20 @@ class NDArray:
         if not self._writable:
             raise MXNetError("trying to write to a read-only NDArray")
         if self._base is None:
+            # Placement is sticky under mutation: a cpu-context array must
+            # not drift to the default platform just because a freshly
+            # computed (uncommitted) value replaces its contents.  An
+            # explicitly committed value — device_put by the caller, or a
+            # sharded mesh output — wins and re-homes the array.
+            old = self._data
+            if (old is not None and getattr(old, "committed", False)
+                    and not getattr(value, "committed", True)):
+                try:
+                    devs = old.devices()
+                    if len(devs) == 1 and devs != value.devices():
+                        value = jax.device_put(value, list(devs)[0])
+                except Exception:
+                    pass
             self._data = value
             return
         base_val = self._base.data

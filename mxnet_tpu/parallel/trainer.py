@@ -33,7 +33,39 @@ from .. import initializer as _init_mod
 from .mesh import batch_sharding, replicated
 from .optim import make_update_fn
 
-__all__ = ["Trainer"]
+__all__ = ["Trainer", "remat_policy"]
+
+
+def remat_policy(name):
+    """Resolve a rematerialization policy for the fused step.
+
+    The step is usually HBM-bandwidth-bound, not MXU-bound (see
+    ROOFLINE.json / docs/how_to/perf.md): rematerialization trades the
+    idle MXU's free flops for scarce HBM bytes by storing fewer
+    residuals and recomputing the rest inside backward.  Policies:
+
+    - ``"none"``: save every residual (jax default; most HBM traffic).
+    - ``"convs_dots"``: save only conv / matmul outputs — the cheap
+      epilogues (BatchNorm, ReLU, adds) are recomputed in backward, so
+      their activations are never round-tripped through HBM.
+    - ``"dots"``: save only matmul outputs (``dots_saveable``) — for
+      transformer-shaped models; on conv nets this recomputes convs too.
+    - ``"nothing"``: full remat — backward recomputes the entire
+      forward (least memory, most recompute flops).
+    """
+    import jax.ad_checkpoint as adc
+    if name in (None, "", "none"):
+        return None
+    if name == "convs_dots":
+        def save_convs_dots(prim, *_, **__):
+            return prim.name in ("conv_general_dilated", "dot_general")
+        return save_convs_dots
+    if name == "dots":
+        return adc.checkpoint_policies.dots_saveable
+    if name == "nothing":
+        return adc.checkpoint_policies.nothing_saveable
+    raise MXNetError("unknown remat policy %r (none|convs_dots|dots|"
+                     "nothing)" % (name,))
 
 
 class Trainer:
@@ -51,7 +83,8 @@ class Trainer:
     def __init__(self, symbol, optimizer, data_names: Sequence[str] = ("data",),
                  label_names: Sequence[str] = ("softmax_label",),
                  mesh=None, compute_dtype=None,
-                 param_specs: Optional[Dict[str, PartitionSpec]] = None):
+                 param_specs: Optional[Dict[str, PartitionSpec]] = None,
+                 remat: Optional[str] = None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -75,6 +108,9 @@ class Trainer:
             d.process_index != jax.process_index()
             for d in mesh.devices.flat)
         self.compute_dtype = _dtype(compute_dtype) if compute_dtype else None
+        import os as _os
+        self.remat = remat if remat is not None \
+            else _os.environ.get("MXTPU_REMAT", "none")
         self.param_specs = param_specs or {}
         input_set = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in self.prog.arg_names
@@ -210,12 +246,16 @@ class Trainer:
             outs, new_aux = prog._eval(vals, list(aux_vals), key, is_train)
             return outs, new_aux
 
+        policy = remat_policy(self.remat)
+
         def step(params, aux, opt_state, batch, lr, t, key):
             aux_vals = [aux[n] for n in aux_names]
 
             def fwd(p):
                 return _forward(p, aux_vals, batch, key, True)
 
+            if policy is not None:
+                fwd = jax.checkpoint(fwd, policy=policy)
             (outs, new_aux), vjp = jax.vjp(fwd, params)
             cot = (tuple(jnp.ones(o.shape, o.dtype) for o in outs),
                    tuple(jnp.zeros(a.shape, a.dtype) for a in new_aux))

@@ -16,7 +16,10 @@
 // bytes to the reference and to mxnet_tpu/recordio.py.
 //
 // Output layout: float32 CHW, channels in BGR order (the reference's
-// OpenCV convention, matched by the python ImageRecordIter).
+// OpenCV convention, matched by the python ImageRecordIter) — or
+// channels-last HWC via mxt_loader_set_layout(h, 1): the TPU-native
+// layout (lanes = channels), decoded straight into place so an NHWC
+// consumer never transposes or re-uploads.
 //
 // Build: native/Makefile -> mxnet_tpu/lib/libmxtpu_dataloader.so
 
@@ -149,6 +152,7 @@ struct Loader {
   size_t cursor = 0;
 
   int batch, channels, height, width, label_width;
+  bool channels_last = false;  // HWC output (NHWC batches)
   bool shuffle, rand_crop, rand_mirror;
   int resize_short;
   float scale;
@@ -349,6 +353,25 @@ struct Loader {
       x0 = (img.w - width) / 2;
     }
     bool mirror = rand_mirror && (srng() & 1);
+    if (channels_last) {
+      // HWC float, BGR order, normalize — same math as the CHW loop,
+      // written channels-innermost so an NHWC batch needs no transpose
+      for (int y = 0; y < height; ++y) {
+        const uint8_t *row =
+            img.rgb.data() + (size_t(y0 + y) * img.w + x0) * 3;
+        float *orow = data_out + size_t(y) * width * channels;
+        for (int x = 0; x < width; ++x) {
+          int sx = mirror ? (width - 1 - x) : x;
+          for (int c = 0; c < channels; ++c) {
+            int src_c = channels == 3 ? 2 - c : 0;  // BGR out of RGB
+            orow[size_t(x) * channels + c] =
+                (float(row[size_t(sx) * 3 + src_c]) - mean[c]) / stdv[c] *
+                scale;
+          }
+        }
+      }
+      return true;
+    }
     // CHW float, BGR order, normalize
     for (int c = 0; c < channels; ++c) {
       int src_c = channels == 3 ? 2 - c : 0;  // BGR out of RGB decode
@@ -472,6 +495,11 @@ int mxt_loader_next(void *h, float *data, float *label) {
 // cumulative count of records that failed to read/decode (zero-filled)
 int64_t mxt_loader_failures(void *h) {
   return static_cast<Loader *>(h)->failures.load();
+}
+
+// 1 = channels-last (HWC per sample, NHWC batches); 0 = CHW (default)
+void mxt_loader_set_layout(void *h, int channels_last) {
+  static_cast<Loader *>(h)->channels_last = channels_last != 0;
 }
 
 void mxt_loader_free(void *h) { delete static_cast<Loader *>(h); }

@@ -149,3 +149,28 @@ def test_partial_forward_ordering_and_invalidation():
     with pytest.raises(Exception):
         ex.partial_forward(is_train=False, step=1)   # stale sequence gone
     np.testing.assert_allclose(ex.outputs[0].asnumpy(), want, rtol=1e-6)
+
+
+def test_partial_forward_cold_out_of_range_raises():
+    """A too-large step with no active sequence is an ordering error,
+    not 'done' — returning 0 would let the caller read stale outputs."""
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=3,
+                                name="fc")
+    rng = np.random.RandomState(2)
+    args = {"data": mx.nd.array(rng.randn(2, 4).astype("f")),
+            "fc_weight": mx.nd.array(rng.randn(3, 4).astype("f")),
+            "fc_bias": mx.nd.zeros((3,))}
+    ex = net.bind(mx.cpu(), args=args)
+    with pytest.raises(Exception):
+        ex.partial_forward(is_train=False, step=99)
+    # after a completed sequence, an off-the-end step still reads as done
+    left, step = 1, 0
+    left = ex.partial_forward(is_train=False, step=0)
+    while left:
+        step += 1
+        left = ex.partial_forward(is_train=False, step=step)
+    assert ex.partial_forward(is_train=False, step=step + 1) == 0
+    # ...but a full forward invalidates that too
+    ex.forward(is_train=False)
+    with pytest.raises(Exception):
+        ex.partial_forward(is_train=False, step=99)

@@ -345,3 +345,60 @@ def test_image_det_record_iter(tmp_path):
                              batch_size=1, label_pad_width=15)
     with pytest.raises(Exception, match="object_width"):
         next(iter(it3))
+
+
+def test_c_iter_getters_require_current_batch():
+    """io_iter_data/label/pad raise a contract MXNetError before the
+    first MXDataIterNext and after end-of-stream, instead of an opaque
+    AttributeError (C callers read it via MXGetLastError)."""
+    import numpy as np
+    import pytest
+    from mxnet_tpu import c_api_support as cs
+    from mxnet_tpu.base import MXNetError
+    it = io.NDArrayIter(np.zeros((4, 2), "f"), np.zeros((4,), "f"),
+                        batch_size=2)
+    with pytest.raises(MXNetError, match="no current batch"):
+        cs.io_iter_data(it)
+    while cs.io_iter_next(it):
+        pass
+    with pytest.raises(MXNetError, match="no current batch"):
+        cs.io_iter_label(it)
+
+
+def test_native_loader_nhwc_layout(tmp_path):
+    """layout='NHWC' decodes channels-last in C++ — bit-identical to the
+    CHW output transposed — and output='numpy' keeps batches host-side
+    (one H2D crossing for the consumer, none here)."""
+    import pytest
+    from mxnet_tpu.io import NativeImageRecordIter
+    from mxnet_tpu import recordio
+    from mxnet_tpu._native import dataloader_lib
+    if dataloader_lib() is None:
+        pytest.skip("native data loader not built")
+    from PIL import Image
+    import io as pio
+    rec_path = str(tmp_path / "n.rec")
+    rng = np.random.RandomState(7)
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i in range(6):
+        img = Image.fromarray(rng.randint(0, 255, (40, 36, 3),
+                                          dtype=np.uint8))
+        buf = pio.BytesIO()
+        img.save(buf, format="JPEG", quality=95)
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                buf.getvalue()))
+    rec.close()
+    common = dict(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                  batch_size=3, shuffle=False, rand_crop=True,
+                  rand_mirror=True, seed=5)
+    chw = NativeImageRecordIter(layout="NCHW", **common)
+    nhwc = NativeImageRecordIter(layout="NHWC", output="numpy", **common)
+    assert nhwc.provide_data[0].shape == (3, 32, 32, 3)
+    for a, b in zip(chw, nhwc):
+        assert isinstance(b.data[0], np.ndarray)     # stays host-side
+        assert isinstance(b.label[0], np.ndarray)
+        np.testing.assert_array_equal(
+            a.data[0].asnumpy().transpose(0, 2, 3, 1), b.data[0])
+        np.testing.assert_array_equal(a.label[0].asnumpy(), b.label[0])
+    with pytest.raises(Exception):
+        NativeImageRecordIter(layout="HWCN", **common)

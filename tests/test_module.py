@@ -211,3 +211,46 @@ def test_sequential_module():
             seq.update()
             seq.update_metric(metric, batch.label)
     assert metric.get()[1] > 0.8
+
+
+class _FakeDistKV(mx.kvstore.KVStore):
+    """In-process stand-in for dist_sync with N workers: same local merge
+    semantics, but reports a multi-worker world so init_optimizer's
+    global-batch rescale default is exercised without a launcher."""
+
+    def __init__(self, num_workers=4):
+        super(_FakeDistKV, self).__init__("dist_sync_tpu")
+        self._nw = num_workers
+
+    @property
+    def num_workers(self):
+        return self._nw
+
+
+def test_module_dist_sync_default_rescale_grad():
+    """Default rescale_grad must normalize by the GLOBAL batch (local
+    batch x num_workers) when gradients are summed across dist_sync
+    workers (reference module.py:460-486)."""
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore=_FakeDistKV(num_workers=4))
+    assert mod._optimizer.rescale_grad == pytest.approx(1.0 / (20 * 4))
+
+
+def test_module_dist_sync_rescale_mismatch_warns(caplog):
+    """A manually-built optimizer whose rescale_grad differs from
+    1/(global batch) triggers a warning, like the reference."""
+    import logging
+    train = _toy_data()
+    mod = Module(_softmax_mlp(), context=mx.cpu())
+    mod.bind(data_shapes=train.provide_data,
+             label_shapes=train.provide_label)
+    mod.init_params()
+    optimizer = mx.optimizer.SGD(learning_rate=0.1, rescale_grad=1.0 / 20)
+    with caplog.at_level(logging.WARNING):
+        mod.init_optimizer(kvstore=_FakeDistKV(num_workers=4),
+                           optimizer=optimizer)
+    assert any("rescale_grad" in rec.message for rec in caplog.records)

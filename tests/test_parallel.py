@@ -216,3 +216,46 @@ def test_moe_expert_parallel():
     for leaf in jax.tree.leaves(g):
         assert np.isfinite(np.asarray(leaf)).all()
         assert float(jnp.abs(leaf).sum()) > 0
+
+
+@pytest.mark.parametrize("policy", ["convs_dots", "dots", "nothing"])
+def test_trainer_remat_matches_no_remat(policy):
+    """Remat changes WHERE residuals come from (recompute vs HBM), never
+    the math: params after identical steps match the no-remat trainer."""
+    rng = np.random.RandomState(5)
+    x = rng.randn(8, 6, 6, 3).astype("f")
+    y = (rng.rand(8) * 4).astype("int").astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.symbol.Convolution(data, num_filter=8, kernel=(3, 3),
+                                layout="NHWC", name="c1")
+    net = mx.symbol.BatchNorm(net, name="bn1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.Flatten(net)
+    net = mx.symbol.FullyConnected(net, num_hidden=4, name="fc")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+
+    def run(remat):
+        t = parallel.Trainer(sym, mx.optimizer.create(
+            "sgd", learning_rate=0.1, momentum=0.9, rescale_grad=1.0 / 8),
+            remat=remat)
+        t.bind(data_shapes={"data": (8, 6, 6, 3)},
+               label_shapes={"softmax_label": (8,)})
+        mx.random.seed(11)
+        t.init_params(mx.init.Xavier())
+        for _ in range(3):
+            t.step({"data": x, "softmax_label": y})
+        return {n: np.asarray(v) for n, v in t.params.items()}
+
+    base = run("none")
+    test = run(policy)
+    for n in base:
+        np.testing.assert_allclose(base[n], test[n], rtol=2e-5, atol=2e-6,
+                                   err_msg=n)
+
+
+def test_trainer_remat_env_default(monkeypatch):
+    monkeypatch.setenv("MXTPU_REMAT", "convs_dots")
+    t = parallel.Trainer(_mlp(), mx.optimizer.create("sgd"))
+    assert t.remat == "convs_dots"
+    with pytest.raises(Exception):
+        parallel.trainer.remat_policy("bogus")

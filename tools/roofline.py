@@ -7,10 +7,19 @@ reference's analog is ``tools/bandwidth/measure.py`` (PCIe/ps-lite
 bandwidth); here the interesting ceilings are the MXU and HBM.
 
 Method: a ``lax.fori_loop`` whose body carries a data dependency
-(``y = y @ w`` resp. ``y = y + c``) so XLA cannot elide or overlap
+(``y = y @ w`` resp. streaming update) so XLA cannot elide or overlap
 iterations; completion is forced by pulling a scalar reduction to the
 host (``block_until_ready`` is unreliable through the axon tunnel —
 see bench.py).
+
+The HBM peak is the BEST of several streaming patterns (add / copy-scale
+/ triad), because no single pattern is guaranteed to saturate; each
+pattern's number and its XLA cost-model byte count are recorded, so the
+artifact doubles as a CALIBRATION of the cost model: on these kernels
+the true traffic is known analytically, and ``cost_model_bytes_ratio``
+says how much the cost model over- or under-counts relative to that
+(round-3 verdict #1: the train-step byte accounting must be coherent
+with the measured peak).
 """
 import json
 import os
@@ -21,14 +30,29 @@ import numpy as np
 
 
 def _run(fn, *args):
-    """Jitted fn -> (result, seconds) with host-side completion barrier."""
+    """Jitted fn -> seconds, with host-side completion barrier."""
     import jax.numpy as jnp
     out = fn(*args)                     # warmup + compile
-    float(jnp.sum(out).astype(np.float32))
+    float(jnp.sum(out[0] if isinstance(out, tuple) else out)
+          .astype(np.float32))
     t0 = time.perf_counter()
     out = fn(*args)
-    float(jnp.sum(out).astype(np.float32))
+    float(jnp.sum(out[0] if isinstance(out, tuple) else out)
+          .astype(np.float32))
     return time.perf_counter() - t0
+
+
+def _cost_bytes(fn, *args):
+    """XLA cost-model 'bytes accessed' for the compiled fn (total, not
+    per-iteration)."""
+    try:
+        comp = fn.lower(*args).compile()
+        ca = comp.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca.get("bytes accessed", 0.0))
+    except Exception:                                   # noqa: BLE001
+        return None
 
 
 def measure_matmul_tflops(n=16384, iters=64, dtype="bfloat16"):
@@ -50,22 +74,58 @@ def measure_matmul_tflops(n=16384, iters=64, dtype="bfloat16"):
     return 2.0 * n ** 3 * iters / secs / 1e12
 
 
-def measure_hbm_gbps(mib=2048, iters=128):
-    """Chained elementwise adds over an HBM-resident array: each iteration
-    streams the array in and out once (2 x size bytes)."""
+def hbm_patterns(mib=2048, iters=128):
+    """Streaming kernels with analytically known HBM traffic.
+
+    Each returns (name, jitted_fn, args, true_bytes_per_iter).  All
+    carry a loop data dependency so iterations can't fuse away."""
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     n = mib * (1 << 20) // 4
     x = jnp.zeros((n,), jnp.float32)
+    b = jnp.ones((n,), jnp.float32)
 
     @jax.jit
-    def chain(x):
+    def add(x):                      # read x, write x'
         return lax.fori_loop(0, iters, lambda i, y: y + 1.0, x)
 
-    secs = _run(chain, x)
-    return 2.0 * n * 4 * iters / secs / 1e9
+    @jax.jit
+    def scale(x):                    # read x, write x'
+        return lax.fori_loop(0, iters, lambda i, y: y * 1.000001, x)
+
+    @jax.jit
+    def triad(x, b):                 # read y + read b, write y'
+        return lax.fori_loop(0, iters,
+                             lambda i, y: y + 2.0 * b, x)
+
+    sz = float(n * 4)
+    return [
+        ("add", add, (x,), 2.0 * sz),
+        ("scale", scale, (x,), 2.0 * sz),
+        ("triad", triad, (x, b), 3.0 * sz),
+    ]
+
+
+def measure_hbm_gbps(mib=2048, iters=128):
+    """Best streaming bandwidth over the pattern set + per-pattern
+    detail + cost-model calibration."""
+    detail = {}
+    best = 0.0
+    for name, fn, args, true_bytes in hbm_patterns(mib, iters):
+        secs = _run(fn, *args)
+        gbps = true_bytes * iters / secs / 1e9
+        row = {"gbps": round(gbps, 2)}
+        cb = _cost_bytes(fn, *args)
+        if cb:
+            # fori_loop cost analysis may count the loop body once or
+            # per-iteration depending on XLA version; normalize per iter
+            per_iter = cb / iters if cb > 2 * true_bytes else cb
+            row["cost_model_bytes_ratio"] = round(per_iter / true_bytes, 3)
+        detail[name] = row
+        best = max(best, gbps)
+    return best, detail
 
 
 def main():
@@ -75,13 +135,12 @@ def main():
     # small sizes keep the CPU-CI path fast; real numbers need the chip
     if on_accel:
         # sizes chosen so the ~70-90 ms tunnel dispatch overhead is <3%
-        # of the timed region (measured: results converge at these sizes
-        # — 181 TF/s / 587 GB/s on v5e, vs 197 / 819 spec)
+        # of the timed region (measured: results converge at these sizes)
         tflops = measure_matmul_tflops(n=16384, iters=64)
-        gbps = measure_hbm_gbps(mib=2048, iters=128)
+        gbps, detail = measure_hbm_gbps(mib=2048, iters=128)
     else:
         tflops = measure_matmul_tflops(n=512, iters=4, dtype="float32")
-        gbps = measure_hbm_gbps(mib=32, iters=4)
+        gbps, detail = measure_hbm_gbps(mib=32, iters=4)
 
     result = {
         "device": str(dev.device_kind if hasattr(dev, "device_kind")
@@ -89,6 +148,7 @@ def main():
         "platform": dev.platform,
         "bf16_matmul_tflops": round(tflops, 2),
         "hbm_gbps": round(gbps, 2),
+        "hbm_patterns": detail,
     }
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "ROOFLINE.json")
