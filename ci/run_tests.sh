@@ -5,8 +5,19 @@
 #   3. multi-process distributed tests (local launcher)
 #   4. cpu-vs-tpu consistency (skips cleanly without a TPU)
 #   5. driver entry points (bench JSON + multichip dryrun)
+#
+# Expected wall time on the 1-core CI host: ~16 min unit suite +
+# ~4 min distributed/recovery + bench (CI-bounded: the bench pipeline
+# section is capped at MXTPU_BENCH_PIPELINE_STEPS=4 batches here; the
+# perf-artifact run uses the default window).  Total ~22 min without a
+# TPU; on a multi-core host the unit suite parallelizes decode/launcher
+# subprocesses and lands well under 15 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# bound the bench's real-input-pipeline section in CI (a knob, see
+# bench.py _pipeline_bench; the driver's perf run uses the default)
+export MXTPU_BENCH_PIPELINE_STEPS="${MXTPU_BENCH_PIPELINE_STEPS:-4}"
 
 echo "=== native build ==="
 make -C native
@@ -37,5 +48,13 @@ python tests/nightly/consistency.py
 echo "=== driver entry points ==="
 python __graft_entry__.py
 python bench.py
+
+echo "=== inference zoo artifact (TPU only; bounded window) ==="
+# refreshes INFER_BENCH.json (reference perf.md scoring-table analog)
+# when a real chip is attached; CI without a TPU keeps the committed one
+if python -c "import jax,sys; sys.exit(0 if jax.devices()[0].platform in ('tpu','axon') else 1)" 2>/dev/null; then
+    python examples/image-classification/benchmark_score.py \
+        --batch-sizes 32 --num-batches 20 --out INFER_BENCH.json
+fi
 
 echo "CI OK"

@@ -54,15 +54,47 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=None,
     return num_batches * batch_size / (time.time() - tic)
 
 
-if __name__ == "__main__":
+# reference P100 batch-32 scoring rows (the zoo table this framework
+# must beat): /root/reference equivalent of docs/how_to/perf.md:134-142
+P100_BATCH32 = {"alexnet": 4883.77, "vgg": 854.4, "inception-bn": 1197.74,
+                "inception-v3": 493.72, "resnet-50": 713.17,
+                "resnet-152": 294.17}
+
+
+def main(argv=None):
     parser = argparse.ArgumentParser(description="score the model zoo")
     parser.add_argument("--networks", type=str,
                         default="alexnet,vgg,inception-bn,inception-v3,"
                                 "resnet-50,resnet-152")
     parser.add_argument("--batch-sizes", type=str, default="1,32")
-    args = parser.parse_args()
+    parser.add_argument("--num-batches", type=int, default=None,
+                        help="override the timed window (CI uses a small "
+                             "bounded one; default scales with batch)")
+    parser.add_argument("--out", type=str, default=None,
+                        help="write a machine-checkable JSON artifact "
+                             "(INFER_BENCH.json) instead of logs only")
+    args = parser.parse_args(argv)
+    rows = []
     for net in args.networks.split(","):
         for b in (int(x) for x in args.batch_sizes.split(",")):
-            speed = score(net, b)
+            speed = score(net, b, num_batches=args.num_batches)
             logging.info("network: %s, batch size: %d, image/sec: %.2f",
                          net, b, speed)
+            row = {"network": net, "batch_size": b,
+                   "img_per_sec": round(speed, 2)}
+            if b == 32 and net in P100_BATCH32:
+                row["p100_img_per_sec"] = P100_BATCH32[net]
+                row["vs_p100"] = round(speed / P100_BATCH32[net], 2)
+            rows.append(row)
+    if args.out:
+        import json
+        import jax
+        artifact = {"device": str(jax.devices()[0].device_kind),
+                    "dtype": "float32", "rows": rows}
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+        print(json.dumps({"rows": len(rows), "out": args.out}))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,12 +73,7 @@ class MultiAccuracy(mx.metric.EvalMetric):
     """Per-head accuracy (reference ``Multi_Accuracy``)."""
 
     def __init__(self, num=2):
-        self.num = num
-        super().__init__("multi-accuracy")
-
-    def reset(self):
-        self.sum_metric = [0.0] * self.num
-        self.num_inst = [0] * self.num
+        super().__init__("multi-accuracy", num=num)
 
     def update(self, labels, preds):
         for i in range(self.num):
@@ -86,11 +81,6 @@ class MultiAccuracy(mx.metric.EvalMetric):
             lab = labels[i].asnumpy().astype("int")
             self.sum_metric[i] += (pred == lab).sum()
             self.num_inst[i] += len(lab)
-
-    def get(self):
-        accs = [s / max(1, n) for s, n in zip(self.sum_metric,
-                                              self.num_inst)]
-        return (["task%d-acc" % i for i in range(self.num)], accs)
 
 
 def make_data(rng, n=256, d=16):

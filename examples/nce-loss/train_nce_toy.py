@@ -67,11 +67,11 @@ class NceIter(mx.io.DataIter):
         self.vocab_size = vocab_size
         self.num_label = num_label
         self.feature_size = feature_size
-        self.rng = np.random.RandomState(seed)
+        self.seed = seed
         # fixed random projection: feature pattern -> class id
-        self.proj = self.rng.randint(1, vocab_size,
-                                     size=(feature_size,))
-        self._batch = 0
+        self.proj = np.random.RandomState(seed).randint(
+            1, vocab_size, size=(feature_size,))
+        self.reset()
 
     @property
     def provide_data(self):
@@ -85,7 +85,10 @@ class NceIter(mx.io.DataIter):
                                                 self.num_label))]
 
     def reset(self):
+        # deterministic epochs: same examples AND same sampled noise
+        # every pass (the toy must be memorizable to assert learning)
         self._batch = 0
+        self.rng = np.random.RandomState(self.seed + 1)
 
     def next(self):
         if self._batch >= self.count:
@@ -99,8 +102,9 @@ class NceIter(mx.io.DataIter):
             bits = self.rng.choice(self.feature_size, 3, replace=False)
             x[i, bits] = 1.0
             true = int(self.proj[bits].sum() % self.vocab_size)
-            cand = [true] + list(self.rng.randint(0, self.vocab_size,
-                                                  L - 1))
+            noise = self.rng.randint(0, self.vocab_size, 4 * L)
+            noise = [n for n in noise if n != true][:L - 1]
+            cand = [true] + noise
             order = self.rng.permutation(L)
             label[i] = np.asarray(cand, "f")[order]
             weight[i] = (np.arange(L)[order] == 0).astype("f")
@@ -124,7 +128,7 @@ class NceAccuracy(mx.metric.EvalMetric):
         self.num_inst += scores.shape[0]
 
 
-def main(epochs=8, batch=32, batches=20):
+def main(epochs=15, batch=32, batches=20):
     logging.basicConfig(level=logging.INFO)
     train = NceIter(batches, batch)
     mod = mx.mod.Module(get_net(), context=mx.cpu(),
@@ -144,7 +148,7 @@ def main(epochs=8, batch=32, batches=20):
 
 if __name__ == "__main__":
     ap = argparse.ArgumentParser()
-    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=15)
     args = ap.parse_args()
     acc = main(epochs=args.epochs)
     assert acc > 0.8, acc

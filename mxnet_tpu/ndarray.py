@@ -555,3 +555,20 @@ def _load_fileobj(f):
 
 def transpose(arr, axes=None):
     return NDArray(jnp.transpose(arr.data, axes))
+
+
+def __getattr__(name):
+    """Ops registered AFTER import — out-of-tree op packages
+    (examples/extension-ops), CustomOp materialization — resolve lazily
+    from the registry (PEP 562), so late registration gets the same
+    ``mx.nd.<op>`` surface as in-tree ops."""
+    from .op import registry as _late_reg
+    try:
+        op = _late_reg.get(name)
+    except Exception:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    from .op.invoke import make_ndarray_function
+    fn = make_ndarray_function(op)
+    globals()[name] = fn
+    return fn

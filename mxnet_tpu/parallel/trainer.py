@@ -322,6 +322,8 @@ class Trainer:
                 continue
             if isinstance(v, NDArray):
                 v = v.data
+            elif isinstance(v, jax.Array):
+                pass          # already on device — never bounce via host
             else:
                 v = jnp.asarray(np.asarray(v))
             if self._batch_shardings is not None:

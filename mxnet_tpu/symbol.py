@@ -524,3 +524,17 @@ def _infer_graph(sym: Symbol, known: Dict[str, Any], partial: bool, what: str):
     out_vals = [results.get((id(nd), i)) for nd, i in sym._outputs]
     aux_names = sym.list_auxiliary_states()
     return var_vals, out_vals, [aux_vals.get(a) for a in aux_names]
+
+
+def __getattr__(name):
+    """Late-registered ops (out-of-tree packages, CustomOp) resolve
+    lazily from the registry — see ndarray.__getattr__."""
+    from .op import registry as _late_reg
+    try:
+        op = _late_reg.get(name)
+    except Exception:
+        raise AttributeError("module %r has no attribute %r"
+                             % (__name__, name))
+    fn = make_symbol_function(op)
+    globals()[name] = fn
+    return fn

@@ -1,0 +1,194 @@
+/* Thin Perl binding over the mxtpu C ABI (libmxtpu_c_api.so).
+ *
+ * The reference shipped a 17k-LoC hand-written perl-package
+ * (AI::MXNet) against the same flat C API; this is the minimal proof
+ * that the 83-function choke point is binding-complete from Perl: raw
+ * NDArray create/copy/shape/free plus MXImperativeInvokeByName, which
+ * reaches EVERY registered operator.  The per-op sugar layer
+ * (lib/MXTPU/Ops.pm) is machine-generated from the live registry by
+ * tools/gen_perl_ops.py, exactly like cpp-package's wrappers.
+ *
+ * Handles cross the boundary as Perl integers (IV holding the
+ * pointer), the same convention the reference's Perl binding used for
+ * its `$handle` scalars.
+ */
+#define PERL_NO_GET_CONTEXT
+#include "EXTERN.h"
+#include "perl.h"
+#include "XSUB.h"
+
+#include <stdlib.h>
+#include <string.h>
+
+typedef unsigned int mx_uint;
+typedef void *NDArrayHandle;
+
+extern int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim,
+                           int dev_type, int dev_id, int delay_alloc,
+                           NDArrayHandle *out);
+extern int MXNDArraySyncCopyFromCPU(NDArrayHandle h, const void *data,
+                                    size_t size);
+extern int MXNDArraySyncCopyToCPU(NDArrayHandle h, void *data, size_t size);
+extern int MXNDArrayGetShape(NDArrayHandle h, mx_uint *out_dim,
+                             const mx_uint **out_pdata);
+extern int MXNDArrayFree(NDArrayHandle h);
+extern int MXImperativeInvokeByName(const char *op, int num_inputs,
+                                    NDArrayHandle *inputs,
+                                    int *num_outputs,
+                                    NDArrayHandle **outputs,
+                                    int num_params,
+                                    const char **param_keys,
+                                    const char **param_vals);
+extern const char *MXGetLastError();
+extern int MXNotifyShutdown();
+
+static void croak_last(pTHX_ const char *what) {
+    croak("%s failed: %s", what, MXGetLastError());
+}
+
+MODULE = MXTPU  PACKAGE = MXTPU
+
+PROTOTYPES: DISABLE
+
+const char *
+last_error()
+    CODE:
+        RETVAL = MXGetLastError();
+    OUTPUT:
+        RETVAL
+
+IV
+nd_create(shape_av)
+        AV *shape_av
+    PREINIT:
+        mx_uint shape[16];
+        mx_uint ndim;
+        mx_uint i;
+        NDArrayHandle out;
+    CODE:
+        ndim = (mx_uint)(av_len(shape_av) + 1);
+        if (ndim > 16) croak("nd_create: ndim > 16");
+        for (i = 0; i < ndim; ++i) {
+            SV **elem = av_fetch(shape_av, i, 0);
+            shape[i] = elem ? (mx_uint)SvUV(*elem) : 0;
+        }
+        if (MXNDArrayCreate(shape, ndim, 1 /* cpu */, 0, 0, &out) != 0)
+            croak_last(aTHX_ "MXNDArrayCreate");
+        RETVAL = PTR2IV(out);
+    OUTPUT:
+        RETVAL
+
+void
+nd_set(h, values_av)
+        IV h
+        AV *values_av
+    PREINIT:
+        size_t n;
+        size_t i;
+        float *buf;
+    PPCODE:
+        n = (size_t)(av_len(values_av) + 1);
+        buf = (float *)malloc(n * sizeof(float));
+        if (buf == NULL) croak("nd_set: out of memory");
+        for (i = 0; i < n; ++i) {
+            SV **elem = av_fetch(values_av, i, 0);
+            buf[i] = elem ? (float)SvNV(*elem) : 0.0f;
+        }
+        if (MXNDArraySyncCopyFromCPU(INT2PTR(NDArrayHandle, h), buf, n)
+                != 0) {
+            free(buf);
+            croak_last(aTHX_ "MXNDArraySyncCopyFromCPU");
+        }
+        free(buf);
+
+void
+nd_values(h)
+        IV h
+    PREINIT:
+        mx_uint ndim;
+        const mx_uint *dims;
+        size_t n;
+        size_t i;
+        float *buf;
+    PPCODE:
+        if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim, &dims)
+                != 0)
+            croak_last(aTHX_ "MXNDArrayGetShape");
+        n = 1;
+        for (i = 0; i < ndim; ++i) n *= dims[i];
+        buf = (float *)malloc(n * sizeof(float));
+        if (buf == NULL) croak("nd_values: out of memory");
+        if (MXNDArraySyncCopyToCPU(INT2PTR(NDArrayHandle, h), buf, n)
+                != 0) {
+            free(buf);
+            croak_last(aTHX_ "MXNDArraySyncCopyToCPU");
+        }
+        EXTEND(SP, (SSize_t)n);
+        for (i = 0; i < n; ++i) PUSHs(sv_2mortal(newSVnv(buf[i])));
+        free(buf);
+
+void
+nd_shape(h)
+        IV h
+    PREINIT:
+        mx_uint ndim;
+        const mx_uint *dims;
+        mx_uint i;
+    PPCODE:
+        if (MXNDArrayGetShape(INT2PTR(NDArrayHandle, h), &ndim, &dims)
+                != 0)
+            croak_last(aTHX_ "MXNDArrayGetShape");
+        EXTEND(SP, (SSize_t)ndim);
+        for (i = 0; i < ndim; ++i) PUSHs(sv_2mortal(newSVuv(dims[i])));
+
+void
+nd_free(h)
+        IV h
+    PPCODE:
+        MXNDArrayFree(INT2PTR(NDArrayHandle, h));
+
+void
+invoke(op, inputs_av, params_hv)
+        const char *op
+        AV *inputs_av
+        HV *params_hv
+    PREINIT:
+        int num_inputs;
+        NDArrayHandle inputs[64];
+        const char *keys[64];
+        const char *vals[64];
+        int num_params;
+        int num_outputs;
+        NDArrayHandle *outputs;
+        HE *entry;
+        int i;
+    PPCODE:
+        num_inputs = (int)(av_len(inputs_av) + 1);
+        if (num_inputs > 64) croak("invoke: too many inputs");
+        for (i = 0; i < num_inputs; ++i) {
+            SV **elem = av_fetch(inputs_av, i, 0);
+            inputs[i] = elem ? INT2PTR(NDArrayHandle, SvIV(*elem)) : NULL;
+        }
+        num_params = 0;
+        hv_iterinit(params_hv);
+        while ((entry = hv_iternext(params_hv)) != NULL) {
+            I32 klen;
+            if (num_params >= 64) croak("invoke: too many params");
+            keys[num_params] = hv_iterkey(entry, &klen);
+            vals[num_params] = SvPV_nolen(hv_iterval(params_hv, entry));
+            ++num_params;
+        }
+        num_outputs = 0;
+        outputs = NULL;
+        if (MXImperativeInvokeByName(op, num_inputs, inputs,
+                                     &num_outputs, &outputs, num_params,
+                                     keys, vals) != 0)
+            croak_last(aTHX_ op);
+        EXTEND(SP, num_outputs);
+        for (i = 0; i < num_outputs; ++i)
+            PUSHs(sv_2mortal(newSViv(PTR2IV(outputs[i]))));
+
+void
+shutdown()
+    PPCODE:
+        MXNotifyShutdown();

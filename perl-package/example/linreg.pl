@@ -1,0 +1,64 @@
+#!/usr/bin/perl
+# Train a linear regression from Perl through the mxtpu C ABI: data and
+# parameters are NDArrays, every compute step is a registered operator
+# reached via MXImperativeInvokeByName, and the SGD update is the
+# manual gradient formula (dW = X^T (XW - y) / N) — no Python in this
+# application.  Asserts the learned weights recover the generating ones.
+use strict;
+use warnings;
+use FindBin;
+use lib "$FindBin::Bin/../lib", "$FindBin::Bin/../blib/arch";
+
+use MXTPU;
+use MXTPU::Ops;
+
+my ($N, $D) = (64, 4);
+my @true_w = (0.5, -1.25, 2.0, 0.75);
+
+# synthetic data: fixed LCG so the script is deterministic
+my $seed = 12345;
+sub urand { $seed = ($seed * 1103515245 + 12345) % (1 << 31);
+            return $seed / (1 << 31) - 0.5 }
+
+my (@xv, @yv);
+for my $i (0 .. $N - 1) {
+    my $dot = 0;
+    for my $j (0 .. $D - 1) {
+        my $v = 2.0 * urand();
+        push @xv, $v;
+        $dot += $v * $true_w[$j];
+    }
+    push @yv, $dot + 0.01 * urand();
+}
+
+my $X  = MXTPU::array(\@xv, [$N, $D]);
+my $Xt = (MXTPU::Ops::transpose([$X], {}))[0];
+my $y  = MXTPU::array(\@yv, [$N, 1]);
+my $W  = MXTPU::array([map { 0.0 } 1 .. $D], [$D, 1]);
+
+my $lr = 0.5 / $N;
+my $loss0;
+my $loss;
+for my $it (1 .. 100) {
+    my ($pred) = MXTPU::Ops::dot([$X, $W], {});
+    my ($err)  = MXTPU::Ops::_minus([$pred, $y], {});
+    my ($sq)   = MXTPU::Ops::square([$err], {});
+    my ($s)    = MXTPU::Ops::sum([$sq], {});
+    ($loss)    = MXTPU::nd_values($s);
+    $loss0 = $loss if $it == 1;
+    my ($grad) = MXTPU::Ops::dot([$Xt, $err], {});
+    my ($step) = MXTPU::Ops::_mul_scalar([$grad], {scalar => $lr});
+    ($W)       = MXTPU::Ops::_minus([$W, $step], {});
+    for my $h ($pred, $err, $sq, $s, $grad, $step) { MXTPU::nd_free($h) }
+}
+
+my @w = MXTPU::nd_values($W);
+printf("loss %.4f -> %.6f; w = [%s]\n", $loss0, $loss,
+       join(", ", map { sprintf("%.3f", $_) } @w));
+die "loss did not collapse" unless $loss < 1e-3 * $loss0;
+for my $j (0 .. $D - 1) {
+    die "w[$j] off: $w[$j] vs $true_w[$j]"
+        if abs($w[$j] - $true_w[$j]) > 0.05;
+}
+print "PERL BINDING OK\n";
+MXTPU::shutdown();

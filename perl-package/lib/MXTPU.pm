@@ -1,0 +1,27 @@
+package MXTPU;
+# Thin Perl binding over the mxtpu C ABI — see MXTPU.xs.  The per-op
+# layer (MXTPU::Ops) is machine-generated from the live op registry by
+# tools/gen_perl_ops.py, like cpp-package's wrappers.
+use strict;
+use warnings;
+
+our $VERSION = '0.01';
+
+# DynaLoader with RTLD_GLOBAL (dl_load_flags 0x01): the embedded
+# CPython inside libmxtpu_c_api.so loads numpy's own C extensions,
+# which resolve libpython symbols from the GLOBAL namespace — a plain
+# RTLD_LOCAL load (XSLoader default) would leave them dangling.
+require DynaLoader;
+our @ISA = ('DynaLoader');
+sub dl_load_flags { 0x01 }
+__PACKAGE__->bootstrap($VERSION);
+
+# convenience: build an NDArray from a flat list + shape
+sub array {
+    my ($values, $shape) = @_;
+    my $h = nd_create($shape);
+    nd_set($h, $values);
+    return $h;
+}
+
+1;

@@ -575,3 +575,107 @@ def test_sweep_report(capsys):
               "%d names gradient-checked, %d forward-checked" %
               (n_reg, len(_registry._ALIASES), len(grads), len(fwds)))
     assert len(grads) >= 150, "gradient-checked op names below target"
+
+
+# ======================================================================
+# Reduced-precision tier (the reference crossed dtypes with
+# check_consistency's fp16-vs-fp32 executor pairs, test_utils.py:676).
+# Every gradient-checked op runs a bf16 forward-consistency check
+# against its own f32 forward; the flagship-model core additionally
+# runs f16.  Integral-valued inputs (indices, labels, masks) stay f32 —
+# bf16 would corrupt ids above 256 and the contract under test is the
+# op's float arithmetic, not its index plumbing.
+
+# ops whose grad-case CONTRACT cannot run reduced (reason required):
+LOWP_SKIP = {
+    # output is integer-exact positions; bf16 quantizes the .5-spaced
+    # input grid used by the case into ties
+    "argmax_channel": "tie-breaking contract needs exact input grid",
+}
+
+# flagship core (ResNet/transformer hot path): must hold in f16 too
+F16_CORE = {
+    "Convolution", "Deconvolution", "FullyConnected", "BatchNorm",
+    "Activation", "Pooling", "SoftmaxOutput", "softmax", "relu",
+    "sigmoid", "tanh", "exp", "log", "sqrt", "square", "dot",
+    "batch_dot", "broadcast_add", "broadcast_mul", "broadcast_sub",
+    "broadcast_div", "elemwise_add", "_plus", "_minus", "_mul", "_div",
+    "sum", "mean", "max", "min", "transpose", "Reshape", "Flatten",
+    "Concat", "slice", "SliceChannel", "Embedding", "LayerNorm",
+    "Dropout", "LeakyReLU", "clip", "abs", "negative",
+}
+
+def _lowp_eligible(c):
+    """grad cases + deterministic fwd cases (samplers re-key between
+    the two executors, so rng ops can't be consistency-compared)."""
+    if c["kind"] == "imp" or c["op"] in LOWP_SKIP:
+        return False
+    if c["kind"] == "fwd":
+        try:
+            if _registry.get(c["op"]).uses_rng:
+                return False
+        except Exception:
+            return False
+    return True
+
+
+_GRAD_OPS_SEEN = set()
+_LOWP_CASES = []
+for _c in sorted(CASES, key=lambda c: c["kind"] != "grad"):
+    if not _lowp_eligible(_c):
+        continue
+    if _c["op"] in _GRAD_OPS_SEEN:
+        continue                      # one dtype crossing per op name
+    _GRAD_OPS_SEEN.add(_c["op"])
+    _LOWP_CASES.append((_c, "bfloat16"))
+    if _c["op"] in F16_CORE:
+        _LOWP_CASES.append((_c, "float16"))
+
+
+def _forward_in_dtype(case, dtype):
+    sym, aux = _build_symbol(case)
+
+    def cast(v):
+        v = np.asarray(v, "f")
+        arr = mx.nd.array(v)
+        if dtype != "float32" and v.dtype.kind == "f" \
+                and not np.all(v == np.round(v)):
+            return arr.astype(dtype)
+        return arr
+    args = {k: cast(v) for k, v in case["loc"].items()}
+    auxs = {k: cast(v) for k, v in (aux or {}).items()} or None
+    exe = sym.bind(mx.current_context(), args=args, aux_states=auxs)
+    exe.forward(is_train=False)
+    return [o.asnumpy().astype(np.float32) for o in exe.outputs]
+
+
+@pytest.mark.parametrize(
+    "case,dtype", _LOWP_CASES,
+    ids=["%s::%s" % (c["id"], "half" if d == "float16" else "bf16")
+         for c, d in _LOWP_CASES])
+def test_op_lowp_forward(case, dtype):
+    """Reduced-precision forward tracks the op's own f32 forward within
+    representation tolerance (~2^-8 for bf16, ~2^-10 for f16, headroom
+    for accumulation)."""
+    ref = _forward_in_dtype(case, "float32")
+    low = _forward_in_dtype(case, dtype)
+    rtol = 0.06 if dtype == "bfloat16" else 0.02
+    for a, b in zip(ref, low):
+        scale = max(float(np.abs(a).max()), 1e-2)
+        np.testing.assert_allclose(
+            b, a, rtol=rtol, atol=rtol * scale,
+            err_msg="%s diverges in %s" % (case["id"], dtype))
+
+
+def test_lowp_report(capsys):
+    bf16 = {c["op"] for c, d in _LOWP_CASES if d == "bfloat16"}
+    f16 = {c["op"] for c, d in _LOWP_CASES if d == "float16"}
+    with capsys.disabled():
+        print("\nLOW-PRECISION SWEEP: %d ops bf16 forward-checked, "
+              "%d flagship-core ops also f16; %d skipped (%s)" %
+              (len(bf16), len(f16), len(LOWP_SKIP),
+               ", ".join(sorted(LOWP_SKIP))))
+    assert len(bf16) >= 140
+    missing_core = {n for n in F16_CORE
+                    if n in {c["op"] for c in CASES}} - f16
+    assert not missing_core, missing_core

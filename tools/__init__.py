@@ -1,0 +1,2 @@
+# Makes the analysis helpers importable (tools.stepcost) from bench.py
+# and the perf tools; the CLI scripts in here still run standalone.

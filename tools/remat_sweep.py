@@ -29,8 +29,7 @@ def bench_policy(policy, batch=256, image=224, steps=60, warmup=5):
     throughput + cost-model accounting."""
     os.environ["MXTPU_MODULE_FUSED"] = "always"
     os.environ["MXTPU_REMAT"] = policy
-    import jax
-    import jax.numpy as jnp
+    import jax  # noqa: F401  (backend init before Module construction)
     import mxnet_tpu as mx
     from mxnet_tpu import io, models
 
@@ -55,23 +54,10 @@ def bench_policy(policy, batch=256, image=224, steps=60, warmup=5):
                               label=[mx.nd.array(y)], pad=0)
     metric = mx.metric.create("acc")
 
-    def one_step():
-        mod.forward(data_batch, is_train=True)
-        mod.update()
-        mod.update_metric(metric, data_batch.label)
-
-    t0 = time.perf_counter()
-    for _ in range(warmup):
-        one_step()
-    metric.get()          # completion barrier (axon block_until_ready no-op)
-    compile_s = time.perf_counter() - t0
-    metric.reset()
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        one_step()
-    metric.get()
-    elapsed = time.perf_counter() - t0
+    from tools.stepcost import (compile_step, cost_analysis,
+                                timed_module_steps)
+    elapsed, compile_s = timed_module_steps(mod, metric, data_batch,
+                                            steps, warmup=warmup)
     img_s = batch * steps / elapsed
 
     row = {"policy": policy,
@@ -79,17 +65,11 @@ def bench_policy(policy, batch=256, image=224, steps=60, warmup=5):
            "step_ms": round(1e3 * elapsed / steps, 2),
            "compile_warmup_s": round(compile_s, 1)}
     try:
-        t = mod._trainer
-        comp = t._step_fn.lower(
-            t.params, t.aux, t.opt_state,
-            {"data": data_batch.data[0].data,
-             "softmax_label": data_batch.label[0].data},
-            jnp.float32(0.1), jnp.int32(1), t._key).compile()
-        ca = comp.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-        byts = float(ca.get("bytes accessed", 0.0))
+        comp = compile_step(mod._trainer,
+                            {"data": data_batch.data[0].data,
+                             "softmax_label": data_batch.label[0].data})
+        ca = cost_analysis(comp)
+        flops, byts = ca["flops"], ca["bytes"]
         row["cost_model_tflop_per_step"] = round(flops / 1e12, 3)
         row["cost_model_gb_per_step"] = round(byts / 1e9, 2)
         row["achieved_tflops"] = round(flops * img_s / batch / 1e12, 1)

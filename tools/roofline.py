@@ -74,11 +74,22 @@ def measure_matmul_tflops(n=16384, iters=64, dtype="bfloat16"):
     return 2.0 * n ** 3 * iters / secs / 1e12
 
 
-def hbm_patterns(mib=2048, iters=128):
-    """Streaming kernels with analytically known HBM traffic.
+# One body table drives BOTH the looped bandwidth kernels and the
+# single-shot calibration kernels, so they cannot drift apart:
+# (name, body(carry, aux) -> carry', uses_aux, bytes_multiplier)
+_HBM_BODIES = [
+    ("add", lambda y, b: y + 1.0, False, 2.0),       # read y, write y'
+    ("scale", lambda y, b: y * 1.000001, False, 2.0),
+    ("triad", lambda y, b: y + 2.0 * b, True, 3.0),  # + read b
+]
 
-    Each returns (name, jitted_fn, args, true_bytes_per_iter).  All
-    carry a loop data dependency so iterations can't fuse away."""
+
+def hbm_patterns(mib=2048, iters=128):
+    """(name, looped_fn, single_fn, args, true_bytes_per_pass) for each
+    streaming body.  The looped variant carries a data dependency so
+    iterations can't fuse away; the single-shot variant is the same
+    body once — used to calibrate the cost model, whose fori_loop
+    accounting counts the body once rather than per iteration."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -87,44 +98,31 @@ def hbm_patterns(mib=2048, iters=128):
     x = jnp.zeros((n,), jnp.float32)
     b = jnp.ones((n,), jnp.float32)
 
-    @jax.jit
-    def add(x):                      # read x, write x'
-        return lax.fori_loop(0, iters, lambda i, y: y + 1.0, x)
-
-    @jax.jit
-    def scale(x):                    # read x, write x'
-        return lax.fori_loop(0, iters, lambda i, y: y * 1.000001, x)
-
-    @jax.jit
-    def triad(x, b):                 # read y + read b, write y'
-        return lax.fori_loop(0, iters,
-                             lambda i, y: y + 2.0 * b, x)
-
-    sz = float(n * 4)
-    return [
-        ("add", add, (x,), 2.0 * sz),
-        ("scale", scale, (x,), 2.0 * sz),
-        ("triad", triad, (x, b), 3.0 * sz),
-    ]
+    out = []
+    for name, body, uses_aux, mult in _HBM_BODIES:
+        looped = jax.jit(lambda x, b, _body=body: lax.fori_loop(
+            0, iters, lambda i, y: _body(y, b), x))
+        single = jax.jit(lambda x, b, _body=body: _body(x, b))
+        args = (x, b)
+        out.append((name, looped, single, args, mult * n * 4))
+    return out
 
 
 def measure_hbm_gbps(mib=2048, iters=128):
     """Best streaming bandwidth over the pattern set + per-pattern
-    detail + cost-model calibration."""
+    detail + cost-model calibration (single-shot body, see
+    hbm_patterns)."""
     detail = {}
     best = 0.0
-    for name, fn, args, true_bytes in hbm_patterns(mib, iters):
-        secs = _run(fn, *args)
+    for name, looped, single, args, true_bytes in hbm_patterns(mib, iters):
+        secs = _run(looped, *args)
         gbps = true_bytes * iters / secs / 1e9
-        row = {"gbps": round(gbps, 2)}
-        cb = _cost_bytes(fn, *args)
-        if cb:
-            # fori_loop cost analysis may count the loop body once or
-            # per-iteration depending on XLA version; normalize per iter
-            per_iter = cb / iters if cb > 2 * true_bytes else cb
-            row["cost_model_bytes_ratio"] = round(per_iter / true_bytes, 3)
-        detail[name] = row
+        detail[name] = {"gbps": round(gbps, 2)}
         best = max(best, gbps)
+        cb = _cost_bytes(single, *args)
+        if cb:
+            detail[name]["cost_model_bytes_ratio"] = round(
+                cb / true_bytes, 3)
     return best, detail
 
 

@@ -1,0 +1,351 @@
+#!/usr/bin/env python
+"""Per-instruction roofline breakdown of the fused train step.
+
+Answers the round-3 accounting question — XLA's aggregate cost model
+said the step moves more bytes/s than the measured HBM peak, which
+cannot be literally true — by walking the OPTIMIZED HLO entry
+computation instruction by instruction:
+
+  * HBM traffic per instruction = operand bytes + output bytes
+    (fusion internals never touch HBM; parameters/constants/GTEs are
+    free; this is the same accounting the streaming calibration in
+    tools/roofline.py shows the cost model gets exactly right).
+  * MXU flops per convolution/dot parsed from its dims.
+  * roofline time estimate per instruction =
+    max(bytes / hbm_peak, flops / mxu_peak).
+
+The sum of per-instruction estimates vs the measured step time says how
+coherent the accounting is; the sorted table says where the time goes
+(and therefore what an optimization must attack).  Writes
+``STEP_BREAKDOWN.json`` at the repo root.
+"""
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "bf16": 2,
+                "f16": 2, "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8,
+                "f64": 8, "c64": 8, "c128": 16}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+def shape_bytes(shape_str):
+    """Total bytes of an HLO shape string (handles tuples)."""
+    total = 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?(%?[\w.\-]+)\s*=\s*((?:\([^=]*?\)|[\w\[\],{}:()*]+?))\s+"
+    r"([\w\-]+)\((.*)$")
+
+
+def parse_computations(hlo_text):
+    """All computations: {comp_name: [(name, shape_str, opcode, rest)]};
+    the ENTRY computation is stored under the key "ENTRY"."""
+    comps = {}
+    cur = None
+    for ln in hlo_text.splitlines():
+        # computation header: column-0 line ending in "{" with no "=",
+        # e.g. "%fused_computation.3 (p0: bf16[...]) -> bf16[...] {"
+        # or   "ENTRY %main.1234 (Arg_0.1: f32[...]) -> (...) {"
+        if ln and not ln[0].isspace() and ln.rstrip().endswith("{") \
+                and "=" not in ln.split("(")[0]:
+            first = ln.split()[0]
+            if first == "ENTRY":
+                cur = "ENTRY"
+            else:
+                cur = first.lstrip("%")
+            comps[cur] = []
+            continue
+        if cur is None:
+            continue
+        if ln.startswith("}"):
+            cur = None
+            continue
+        im = _INSTR_RE.match(ln)
+        if im:
+            comps[cur].append((im.group(1).lstrip("%"), im.group(2),
+                               im.group(3), im.group(4)))
+    return comps
+
+
+def _operand_dims(rest, idx, shapes):
+    """Dims list of the idx-th operand of an instruction.  Operands are
+    either %name references (resolved via ``shapes``) or inline-typed;
+    handle both by scanning the operand segment."""
+    seg = rest.split("), ")[0]
+    # inline-typed operands: "f32[2,3]{...} %p" pairs
+    inline = _SHAPE_RE.findall(seg)
+    refs = re.findall(r"%([\w.\-]+)", seg)
+    if len(inline) > idx and len(inline) >= len(refs):
+        return inline[idx][1].split(",") if inline[idx][1] else []
+    if len(refs) > idx and refs[idx] in shapes:
+        m = _SHAPE_RE.search(shapes[refs[idx]])
+        if m:
+            return m.group(2).split(",") if m.group(2) else []
+    return None
+
+
+def _win_vec(rest, key, ndim, default):
+    m = re.search(key + r"=([\dx_]+)", rest)
+    if not m:
+        return [default] * ndim
+    return [int(x.split("_")[0]) for x in m.group(1).split("x")]
+
+
+def _win_pad(rest, ndim):
+    m = re.search(r"pad=([\d_x\-]+)", rest)
+    if not m:
+        return [0] * ndim
+    return [int(x.split("_")[0]) for x in m.group(1).split("x")]
+
+
+def conv_flops(shape_str, rest, shapes=None):
+    """Exact MAC count for any convolution form (forward, grad-input,
+    grad-weight): 2 * prod_d(valid (output, tap) pairs in dim d)
+    * out_batch * out_feature * contracted_feature.  Counting only
+    IN-BOUNDS taps matters: grad-weight convs are written with
+    pad ~= window-1, so most taps fall in padding and the naive
+    out*window*cin formula overcounts by orders of magnitude."""
+    shapes = shapes or {}
+    m = _SHAPE_RE.search(shape_str)
+    dl = re.search(r"dim_labels=(\w+)_(\w+)->(\w+)", rest)
+    if not m or not dl:
+        return 0.0
+    out_dims = [int(d) for d in m.group(2).split(",")] if m.group(2) else []
+    lhs_l, k_l, out_l = dl.group(1), dl.group(2), dl.group(3)
+    lhs_dims = _operand_dims(rest, 0, shapes)
+    k_dims = _operand_dims(rest, 1, shapes)
+    if not lhs_dims or not k_dims or len(out_dims) != len(out_l):
+        return 0.0
+    lhs_dims = [int(d) for d in lhs_dims]
+    k_dims = [int(d) for d in k_dims]
+    nsp = len(out_l) - 2
+    stride = _win_vec(rest, "stride", nsp, 1)
+    pad = _win_pad(rest, nsp)
+    lhs_dil = _win_vec(rest, "lhs_dilate", nsp, 1)
+    rhs_dil = _win_vec(rest, "rhs_dilate", nsp, 1)
+    win = _win_vec(rest, r"window={size", nsp, 1)
+    pairs = 1.0
+    for d in range(nsp):
+        O = out_dims[out_l.index(str(d))]
+        I = lhs_dims[lhs_l.index(str(d))]
+        I_eff = (I - 1) * lhs_dil[d] + 1
+        cnt = 0
+        for o in range(O):
+            base = o * stride[d] - pad[d]
+            for k in range(win[d]):
+                pos = base + k * rhs_dil[d]
+                if 0 <= pos < I_eff and pos % lhs_dil[d] == 0:
+                    cnt += 1
+        pairs *= cnt
+    out_b = out_dims[out_l.index("b")]
+    out_f = out_dims[out_l.index("f")]
+    contracted = k_dims[k_l.index("i")]      # per-group by construction
+    return 2.0 * pairs * out_b * out_f * contracted
+
+
+_SHAPE_SPACE_RE = re.compile(r"(\w+)\[([\d,]*)\](\{[^}]*\})?")
+
+
+def hbm_shape_bytes(shape_str):
+    """Bytes of the shapes in ``shape_str`` that live in default memory
+    (HBM) — shapes annotated with a scoped space ``S(n)`` (the
+    VMEM/SMEM staging halves of async copy/slice pairs) don't count as
+    HBM traffic."""
+    total = 0
+    for dtype, dims, layout in _SHAPE_SPACE_RE.findall(shape_str):
+        if dtype not in _DTYPE_BYTES:
+            continue
+        if layout and "S(" in layout:
+            continue
+        n = 1
+        if dims:
+            for d in dims.split(","):
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dtype]
+    return total
+
+
+def dot_flops(shape_str, rest, shapes=None):
+    shapes = shapes or {}
+    m = _SHAPE_RE.search(shape_str)
+    if not m:
+        return 0.0
+    out_elems = 1
+    for d in (m.group(2).split(",") if m.group(2) else []):
+        out_elems *= int(d)
+    cm = re.search(r"rhs_contracting_dims={([\d,]+)}", rest)
+    k = 1
+    rdims = _operand_dims(rest, 1, shapes)
+    if cm and rdims:
+        for ci in cm.group(1).split(","):
+            if int(ci) < len(rdims):
+                k *= int(rdims[int(ci)])
+    return 2.0 * out_elems * k
+
+
+_NO_TRAFFIC = {"parameter", "constant", "get-tuple-element", "bitcast",
+               "tuple", "after-all", "partition-id", "replica-id",
+               "bitcast-convert",
+               # the -start half of an async pair carries the traffic;
+               # counting -done too would double every copy/async op
+               "copy-done", "async-done", "all-reduce-done",
+               "all-gather-done", "collective-permute-done", "send-done",
+               "recv-done"}
+
+
+def analyze(hlo_text, hbm_gbps, mxu_tflops):
+    """Per-instruction byte/flop/roofline-time table.  Conv/dot flops
+    nested inside fusions are attributed to the fusion instruction via
+    its ``calls=`` computation."""
+    comps = parse_computations(hlo_text)
+    instrs = comps.get("ENTRY", [])
+    # flops per non-entry computation (fusion bodies)
+    comp_flops = {}
+    for cname, cinstrs in comps.items():
+        if cname == "ENTRY":
+            continue
+        local_shapes = {n: s for n, s, _, _ in cinstrs}
+        total = 0.0
+        for _, shape, opcode, rest in cinstrs:
+            if opcode == "convolution":
+                total += conv_flops(shape, rest, local_shapes)
+            elif opcode == "dot":
+                total += dot_flops(shape, rest, local_shapes)
+        comp_flops[cname] = total
+    shapes = {name: shape for name, shape, _, _ in instrs}
+    rows = []
+    for name, shape, opcode, rest in instrs:
+        if opcode in _NO_TRAFFIC:
+            continue
+        if opcode.endswith("-start"):
+            # async copy/slice pair: the start's tuple shape lists both
+            # halves with memory-space annotations; count the HBM-side
+            # shapes once and skip the operand scan (the operand IS one
+            # of the tuple halves)
+            out_b, oper_b = hbm_shape_bytes(shape), 0
+        else:
+            out_b = shape_bytes(shape)
+            # operand traffic: %operand names referenced in the call;
+            # their defining shapes (parameters live in HBM too)
+            oper_b = 0
+            for ref in re.findall(r"%([\w.\-]+)",
+                                  rest.split(" calls=")[0]
+                                  .split(" to_apply=")[0]):
+                if ref in shapes:
+                    oper_b += shape_bytes(shapes[ref])
+            # fallback: inline-typed operands (param-less HLO styles)
+            if oper_b == 0:
+                oper_b = shape_bytes(rest)
+        flops = 0.0
+        if opcode == "convolution":
+            flops = conv_flops(shape, rest, shapes)
+        elif opcode == "dot":
+            flops = dot_flops(shape, rest, shapes)
+        elif opcode in ("fusion", "call"):
+            cm = re.search(r"(?:calls|to_apply)=%?([\w.\-]+)", rest)
+            if cm:
+                flops = comp_flops.get(cm.group(1), 0.0)
+        byte_ms = (out_b + oper_b) / (hbm_gbps * 1e9) * 1e3
+        flop_ms = flops / (mxu_tflops * 1e12) * 1e3
+        rows.append({"name": name, "op": opcode,
+                     "gbytes": round((out_b + oper_b) / 1e9, 4),
+                     "gflops": round(flops / 1e9, 2),
+                     "roofline_ms": round(max(byte_ms, flop_ms), 4),
+                     "bound": "mxu" if flop_ms > byte_ms else "hbm"})
+    rows.sort(key=lambda r: -r["roofline_ms"])
+    return rows
+
+
+def main():
+    os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import io, models
+
+    batch, image = 256, 224
+    sym = models.get_symbol("resnet-50", num_classes=1000, layout="NHWC")
+    mod = mx.mod.Module(context=mx.tpu(), symbol=sym,
+                        compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch, image, image, 3))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / batch})
+    t = mod._trainer
+
+    from tools.stepcost import (compile_step, cost_analysis,
+                                timed_module_steps)
+    rng = np.random.RandomState(0)
+    batch_vals = {
+        "data": jnp.asarray(rng.normal(
+            0, 1, (batch, image, image, 3)).astype(np.float32)),
+        "softmax_label": jnp.asarray(
+            rng.randint(0, 1000, (batch,)).astype(np.float32))}
+    comp = compile_step(t, batch_vals)
+    ca = cost_analysis(comp)
+    hlo = comp.as_text()
+
+    roof_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "ROOFLINE.json")
+    roof = json.load(open(roof_path))
+    rows = analyze(hlo, roof["hbm_gbps"], roof["bf16_matmul_tflops"])
+
+    # measure the real step for the coherence check
+    data_batch = io.DataBatch(
+        data=[mx.nd.NDArray(batch_vals["data"])],
+        label=[mx.nd.NDArray(batch_vals["softmax_label"])], pad=0)
+    metric = mx.metric.create("acc")
+    steps = 40
+    elapsed, _ = timed_module_steps(mod, metric, data_batch, steps)
+    measured_ms = elapsed / steps * 1e3
+
+    total_gb = sum(r["gbytes"] for r in rows)
+    total_roofline_ms = sum(r["roofline_ms"] for r in rows)
+    result = {
+        "model": "resnet-50 NHWC bf16 batch 256 fused train step",
+        "measured_step_ms": round(measured_ms, 2),
+        "sum_instruction_roofline_ms": round(total_roofline_ms, 2),
+        "coherence_measured_over_roofline": round(
+            measured_ms / total_roofline_ms, 3) if total_roofline_ms else None,
+        "hlo_walk_gb_per_step": round(total_gb, 2),
+        "cost_model_gb_per_step": round(ca["bytes"] / 1e9, 2),
+        "cost_model_tflop_per_step": round(ca["flops"] / 1e12, 3),
+        "n_instructions": len(rows),
+        "top": rows[:25],
+        "bound_split_ms": {
+            "hbm": round(sum(r["roofline_ms"] for r in rows
+                             if r["bound"] == "hbm"), 2),
+            "mxu": round(sum(r["roofline_ms"] for r in rows
+                             if r["bound"] == "mxu"), 2)},
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "STEP_BREAKDOWN.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({k: v for k, v in result.items() if k != "top"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Shared helpers over the fused Trainer's compiled step.
+
+bench.py, tools/remat_sweep.py, and tools/step_breakdown.py all need
+the same three things: lower+compile the step for a concrete batch,
+read XLA's aggregate cost analysis, and time Module-path steps with the
+axon-safe completion barrier.  Keeping them here means the private
+``Trainer._step_fn`` call signature is stated once — a signature change
+breaks these helpers loudly instead of silently voiding three copies'
+artifact fields.
+"""
+import time
+
+
+def compile_step(trainer, batch_vals, lr=0.1):
+    """Lower + compile the fused step for concrete batch values."""
+    import jax.numpy as jnp
+    return trainer._step_fn.lower(
+        trainer.params, trainer.aux, trainer.opt_state, batch_vals,
+        jnp.float32(lr), jnp.int32(1), trainer._key).compile()
+
+
+def cost_analysis(comp):
+    """{"flops": float, "bytes": float} from a compiled step."""
+    ca = comp.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    return {"flops": float(ca.get("flops", 0.0)),
+            "bytes": float(ca.get("bytes accessed", 0.0))}
+
+
+def timed_module_steps(mod, metric, data_batch, steps, warmup=5):
+    """Run the Module.fit inner loop (forward/update/update_metric) and
+    return (seconds_for_timed_steps, warmup_seconds).  ``metric.get()``
+    drains the device accumulator, which depends on every step's
+    outputs — the honest completion barrier on backends where
+    ``block_until_ready`` does not block (see bench.py)."""
+    def one_step():
+        mod.forward(data_batch, is_train=True)
+        mod.update()
+        mod.update_metric(metric, data_batch.label)
+
+    t0 = time.perf_counter()
+    for _ in range(warmup):
+        one_step()
+    metric.get()
+    warm_s = time.perf_counter() - t0
+    metric.reset()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        one_step()
+    metric.get()
+    return time.perf_counter() - t0, warm_s
