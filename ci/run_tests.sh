@@ -6,12 +6,15 @@
 #   4. cpu-vs-tpu consistency (skips cleanly without a TPU)
 #   5. driver entry points (bench JSON + multichip dryrun)
 #
-# Expected wall time on the 1-core CI host: ~16 min unit suite +
-# ~4 min distributed/recovery + bench (CI-bounded: the bench pipeline
-# section is capped at MXTPU_BENCH_PIPELINE_STEPS=4 batches here; the
-# perf-artifact run uses the default window).  Total ~22 min without a
-# TPU; on a multi-core host the unit suite parallelizes decode/launcher
-# subprocesses and lands well under 15 min.
+# Expected wall time on the 1-core CI host: ~23 min unit suite (838
+# tests incl. the 272-case bf16/f16 op tier and 11 example smoke
+# trainings) + ~5 min distributed/recovery + bench (CI-bounded: the
+# bench pipeline section is capped at MXTPU_BENCH_PIPELINE_STEPS=4
+# batches here; the perf-artifact run uses the default window).
+# Total ~30 min without a TPU; a multi-core host parallelizes the
+# decode/launcher/example subprocesses and lands near half that.
+# Quick iteration: python -m pytest tests/ -x -q -k "not examples and
+# not lowp" runs the core suite in ~12 min.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

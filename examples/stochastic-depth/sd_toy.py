@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Stochastic depth, toy-sized (reference
+``example/stochastic-depth/sd_module.py`` + ``sd_mnist.py``): residual
+blocks whose compute branch is randomly SKIPPED per batch during
+training (saving that block's compute) and averaged by its survival
+rate at inference — implemented, like the reference, as a custom
+``BaseModule`` composed into a ``SequentialModule`` chain with
+auto-wiring.  Exercises module-composition machinery no symbol-level
+example touches: per-stage modules with independent optimizers, the
+interior input-grad chain, and a module whose forward is data-dependent
+Python control flow.
+
+Run: python examples/stochastic-depth/sd_toy.py
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+class StochasticDepthModule(mx.mod.BaseModule):
+    """Two-branch module: identity skip + compute branch that a coin
+    flip disables per training batch (reference ``sd_module.py:19``).
+    At inference the compute branch is scaled by its survival rate
+    (the paper's expectation rule)."""
+
+    def __init__(self, symbol_compute, data_names=("data",),
+                 death_rate=0.0, logger=logging, context=None):
+        super().__init__(logger=logger)
+        self._module_compute = mx.mod.Module(
+            symbol_compute, data_names=data_names, label_names=None,
+            context=context or mx.cpu())
+        self._open_rate = 1.0 - death_rate
+        self._gate_open = True
+        self._outputs = None
+        self._input_grads = None
+        self._rng = np.random.RandomState(4711)
+
+    # -- plumbing delegated to the compute module ----------------------
+    @property
+    def data_names(self):
+        return self._module_compute.data_names
+
+    @property
+    def output_names(self):
+        return self._module_compute.output_names
+
+    @property
+    def data_shapes(self):
+        return self._module_compute.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._module_compute.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._module_compute.output_shapes
+
+    def get_params(self):
+        return self._module_compute.get_params()
+
+    def init_params(self, *args, **kwargs):
+        self._module_compute.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def bind(self, *args, **kwargs):
+        self._module_compute.bind(*args, **kwargs)
+        self.binded = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self._module_compute.init_optimizer(*args, **kwargs)
+        self.optimizer_initialized = True
+
+    # -- the stochastic part -------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self._module_compute.for_training
+        # identity skip branch (shapes match by construction)
+        self._outputs = list(data_batch.data)
+        if is_train:
+            self._gate_open = self._rng.rand() < self._open_rate
+            if self._gate_open:
+                self._module_compute.forward(data_batch, is_train=True)
+                comp = self._module_compute.get_outputs()
+                self._outputs = [o + c for o, c in zip(self._outputs,
+                                                       comp)]
+        else:
+            self._module_compute.forward(data_batch, is_train=False)
+            comp = self._module_compute.get_outputs()
+            self._outputs = [o + self._open_rate * c
+                             for o, c in zip(self._outputs, comp)]
+
+    def backward(self, out_grads=None):
+        # identity branch passes the gradient straight through; the
+        # compute branch adds its input grads only while its gate was
+        # open this batch
+        self._input_grads = list(out_grads)
+        if self._gate_open:
+            self._module_compute.backward(out_grads=out_grads)
+            comp = self._module_compute.get_input_grads()
+            self._input_grads = [g + c for g, c in zip(self._input_grads,
+                                                       comp)]
+
+    def update(self):
+        if self._gate_open:
+            self._module_compute.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._input_grads
+
+    def update_metric(self, eval_metric, labels):
+        pass                              # no labels on interior blocks
+
+    def install_monitor(self, mon):
+        self._module_compute.install_monitor(mon)
+
+
+def _residual_branch(name, data_name, nf=8):
+    net = mx.sym.Variable(data_name)
+    net = mx.sym.Convolution(net, num_filter=nf, kernel=(3, 3),
+                             pad=(1, 1), no_bias=True, name=name + "_c1")
+    net = mx.sym.BatchNorm(net, name=name + "_bn1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Convolution(net, num_filter=nf, kernel=(3, 3),
+                             pad=(1, 1), no_bias=True, name=name + "_c2")
+    return mx.sym.BatchNorm(net, name=name + "_bn2")
+
+
+def build_chain(death_rates=(0.2, 0.4), nf=8, nclass=4):
+    """conv stem -> N stochastic residual blocks -> classifier head,
+    chained exactly like the reference's mod_seq."""
+    stem = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=nf,
+                              kernel=(3, 3), pad=(1, 1), name="stem")
+    stem = mx.sym.Activation(stem, act_type="relu")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(stem, label_names=None, context=mx.cpu()))
+    for i, dr in enumerate(death_rates):
+        branch = _residual_branch("block%d" % i, "data_%d" % i, nf)
+        seq.add(StochasticDepthModule(branch, data_names=("data_%d" % i,),
+                                      death_rate=dr),
+                auto_wiring=True)
+    head = mx.sym.Variable("data_final")
+    head = mx.sym.Activation(head, act_type="relu")
+    head = mx.sym.Flatten(head)
+    head = mx.sym.FullyConnected(head, num_hidden=nclass)
+    head = mx.sym.SoftmaxOutput(head, name="softmax")
+    seq.add(mx.mod.Module(head, data_names=("data_final",),
+                          context=mx.cpu()),
+            auto_wiring=True, take_labels=True)
+    return seq
+
+
+def make_data(rng, n=256, hw=16):
+    """Class = which quadrant holds the bright blob."""
+    x = rng.normal(0, 0.3, (n, 1, hw, hw)).astype("f")
+    y = rng.randint(0, 4, n).astype("f")
+    half = hw // 2
+    for i in range(n):
+        r = (int(y[i]) // 2) * half
+        c = (int(y[i]) % 2) * half
+        x[i, 0, r + 2:r + half - 2, c + 2:c + half - 2] += 1.5
+    return x, y
+
+
+def main(epochs=8, batch=32):
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+    x, y = make_data(rng)
+    it = mx.io.NDArrayIter(x, y, batch_size=batch, shuffle=True)
+    seq = build_chain()
+    metric = mx.metric.create("acc")
+    seq.fit(it, num_epoch=epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier(), eval_metric=metric)
+    it.reset()
+    metric.reset()
+    for b in it:
+        seq.forward(b, is_train=False)
+        metric.update(b.label, seq.get_outputs())
+    return metric.get()[1]
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args()
+    acc = main(epochs=args.epochs)
+    assert acc > 0.9, acc
+    print("stochastic-depth toy OK: acc %.3f" % acc)

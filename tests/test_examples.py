@@ -110,3 +110,11 @@ def test_extension_ops_package():
             vars(mx.nd).pop(name, None)
             vars(mx.sym).pop(name, None)
         sys.modules.pop("mxtpu_contrib_ops", None)
+
+
+def test_bi_lstm_sort_example():
+    _run_example("bi-lstm-sort/train_sort_toy.py", "--epochs", "14")
+
+
+def test_stochastic_depth_example():
+    _run_example("stochastic-depth/sd_toy.py", "--epochs", "8")
