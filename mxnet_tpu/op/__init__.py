@@ -12,3 +12,4 @@ from . import vision  # noqa: F401
 from . import contrib  # noqa: F401
 from . import rnn_op  # noqa: F401
 from . import attention  # noqa: F401
+from . import ctc  # noqa: F401

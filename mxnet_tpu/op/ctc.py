@@ -1,0 +1,230 @@
+"""Connectionist Temporal Classification loss — the ``WarpCTC`` plugin
+analog (reference ``plugin/warpctc/warpctc-inl.h``), implemented as a
+pure-XLA forward-backward recursion instead of a linked CUDA library.
+
+Contract (matches the reference op exactly):
+
+- ``data``: ``(seq_len * batch, vocab)`` activations, TIME-major (the
+  unrolled-RNN concat layout of ``example/warpctc/lstm.py``), class 0
+  is the blank.
+- ``label``: ``(batch, label_length)`` int-valued floats, 0-padded —
+  0 entries are removed (``removeBlank``) so real symbols are 1-based.
+- forward output = ``softmax(data)`` (shape-preserving, like the
+  plugin's Forward which just softmaxes).
+- backward injects the CTC gradient ``softmax - gamma`` where gamma is
+  the per-frame symbol posterior from the alpha-beta recursion, in log
+  space via ``lax.scan`` over time — compiler-friendly control flow,
+  no host callback, batch-vectorized with masks for variable label
+  lengths.
+"""
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import Param, register
+
+_NEG_INF = -1e30
+
+
+def _compact_labels(label, max_len):
+    """Remove 0 (blank/pad) entries, keeping order; returns (compacted
+    int32 (B, L) padded with 0, lengths (B,))."""
+    lab = label.astype(jnp.int32)
+    nonblank = lab != 0
+    # stable argsort of "is-blank" moves real symbols to the front
+    order = jnp.argsort(~nonblank, axis=1, stable=True)
+    compact = jnp.take_along_axis(lab, order, axis=1)
+    lengths = nonblank.sum(axis=1)
+    return compact[:, :max_len], lengths
+
+
+def _ctc_alpha_beta(logp, compact, lengths):
+    """Log-space alpha/beta over the extended label sequence.
+
+    logp: (T, B, V) log-softmax; compact: (B, L) 1-based symbols;
+    lengths: (B,).  Returns (log loss (B,), gamma (T, B, V))."""
+    T, B, V = logp.shape
+    L = compact.shape[1]
+    S = 2 * L + 1
+    # extended sequence: blanks at even s, symbols at odd s
+    z = jnp.zeros((B, S), jnp.int32)
+    z = z.at[:, 1::2].set(compact)
+    s_idx = jnp.arange(S)
+    valid = s_idx[None, :] < (2 * lengths[:, None] + 1)      # (B, S)
+    # a skip (s-2 -> s) is allowed at odd s whose symbol differs from
+    # the previous symbol
+    z_prev2 = jnp.concatenate([jnp.zeros((B, 2), jnp.int32), z[:, :-2]],
+                              axis=1)
+    can_skip = (s_idx[None, :] % 2 == 1) & (z != z_prev2)    # (B, S)
+
+    def emit(t_logp):
+        # (B, S) log prob of emitting each extended state's symbol
+        return jnp.take_along_axis(t_logp, z, axis=1)
+
+    def shifted(a, k):
+        pad = jnp.full((B, k), _NEG_INF, a.dtype)
+        return jnp.concatenate([pad, a[:, :S - k]], axis=1)
+
+    # ---- alpha ----
+    a0 = jnp.full((B, S), _NEG_INF)
+    a0 = a0.at[:, 0].set(emit(logp[0])[:, 0])
+    a0 = a0.at[:, 1].set(jnp.where(lengths > 0, emit(logp[0])[:, 1],
+                                   _NEG_INF))
+
+    def alpha_step(prev, t_logp):
+        stay = prev
+        step1 = shifted(prev, 1)
+        step2 = jnp.where(can_skip, shifted(prev, 2), _NEG_INF)
+        a = jnp.logaddexp(jnp.logaddexp(stay, step1), step2)
+        a = a + emit(t_logp)
+        a = jnp.where(valid, a, _NEG_INF)
+        return a, a
+
+    _, alphas = lax.scan(alpha_step, a0, logp[1:])
+    alphas = jnp.concatenate([a0[None], alphas], axis=0)      # (T, B, S)
+
+    last = 2 * lengths                                        # blank end
+    aT = alphas[-1]
+    end1 = jnp.take_along_axis(aT, last[:, None], axis=1)[:, 0]
+    end2 = jnp.where(
+        lengths > 0,
+        jnp.take_along_axis(aT, jnp.maximum(last - 1, 0)[:, None],
+                            axis=1)[:, 0],
+        _NEG_INF)
+    log_lik = jnp.logaddexp(end1, end2)                       # (B,)
+
+    # ---- beta (reverse recursion) ----
+    bT = jnp.full((B, S), _NEG_INF)
+    bT = bT.at[jnp.arange(B), last].set(0.0)
+    bT = jnp.where((s_idx[None, :] == (last - 1)[:, None]) &
+                   (lengths[:, None] > 0), 0.0, bT)
+
+    def shifted_fwd(a, k):
+        pad = jnp.full((B, k), _NEG_INF, a.dtype)
+        return jnp.concatenate([a[:, k:], pad], axis=1)
+
+    can_skip_fwd = jnp.concatenate([can_skip[:, 2:],
+                                    jnp.zeros((B, 2), bool)], axis=1)
+
+    def beta_step(nxt, t_logp):
+        # beta_t(s) = logsum over s' in {s, s+1, s+2} of
+        #             beta_{t+1}(s') + emit_{t+1}(s')
+        e = emit(t_logp) + nxt
+        stay = e
+        step1 = shifted_fwd(e, 1)
+        step2 = jnp.where(can_skip_fwd, shifted_fwd(e, 2), _NEG_INF)
+        b = jnp.logaddexp(jnp.logaddexp(stay, step1), step2)
+        b = jnp.where(valid, b, _NEG_INF)
+        return b, b
+
+    _, betas_fwd = lax.scan(beta_step, bT, logp[1:], reverse=True)
+    betas = jnp.concatenate([betas_fwd, bT[None]], axis=0)
+
+    # an INFEASIBLE label (needs more frames than input_length, e.g.
+    # repeats requiring interleaved blanks) has no alignment at all:
+    # log_lik collapses to the -1e30 sentinel and the posterior's
+    # sentinel cancellation would produce garbage — zero those rows'
+    # gamma (so grad = softmax, like warp-ctc zeroing) and report an
+    # infinite loss
+    feasible = log_lik > _NEG_INF / 2                         # (B,)
+
+    # ---- gamma: per-frame symbol posterior ----
+    post = alphas + betas - log_lik[None, :, None]            # (T, B, S)
+    post = jnp.where(valid[None] & feasible[None, :, None], post,
+                     _NEG_INF)
+    gamma = jnp.zeros((T, B, V))
+    # scatter-add exp(post) over each state's symbol id
+    gamma = gamma.at[:, jnp.arange(B)[:, None], z].add(jnp.exp(post))
+    nll = jnp.where(feasible, -log_lik, jnp.inf)
+    return nll, gamma
+
+
+def _ctc_grad(data, label, label_length, input_length):
+    TB, V = data.shape
+    T = input_length
+    B = TB // T
+    logits = data.reshape(T, B, V).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    compact, lengths = _compact_labels(label, label.shape[1])
+    nll, gamma = _ctc_alpha_beta(logp, compact, lengths)
+    grad = jnp.exp(logp) - gamma                              # (T, B, V)
+    # infeasible rows get a ZERO gradient, the warp-ctc behavior
+    grad = jnp.where(jnp.isfinite(nll)[None, :, None], grad, 0.0)
+    return grad.reshape(TB, V).astype(data.dtype)
+
+
+def ctc_loss_value(data, label, input_length):
+    """Per-sequence negative log-likelihood, shape ``(batch,)`` —
+    ``inf`` for labels infeasible at this input_length (not part of the
+    reference op's surface; exposed for tests and metrics)."""
+    TB, V = data.shape
+    T = input_length
+    B = TB // T
+    logits = data.reshape(T, B, V).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    compact, lengths = _compact_labels(label, label.shape[1])
+    nll, _ = _ctc_alpha_beta(logp, compact, lengths)
+    return nll
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _warpctc_p(label_length, input_length, data, label):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _warpctc_fwd(label_length, input_length, data, label):
+    return _warpctc_p(label_length, input_length, data, label), \
+        (data, label)
+
+
+def _warpctc_bwd(label_length, input_length, res, g):
+    data, label = res
+    grad = _ctc_grad(data, label, label_length, input_length)
+    return grad, jnp.zeros_like(label)
+
+
+_warpctc_p.defvjp(_warpctc_fwd, _warpctc_bwd)
+
+
+@register("WarpCTC",
+          params_spec=(Param("label_length", int, 0),
+                       Param("input_length", int, 0)),
+          input_names=("data", "label"), hint="warpctc")
+def _warpctc(p, c, data, label):
+    return _warpctc_p(p["label_length"], p["input_length"], data, label)
+
+
+def _warpctc_infer_shape(p, in_shapes):
+    dshape = in_shapes[0]
+    if dshape is None:
+        return None
+    batch = dshape[0] // max(1, p["input_length"])
+    lshape = (batch, p["label_length"])
+    return [tuple(dshape), lshape], [tuple(dshape)], []
+
+
+from . import registry as _reg_mod  # noqa: E402
+_reg_mod.get("WarpCTC").infer_shape = _warpctc_infer_shape
+
+
+def ctc_greedy_decode(probs, seq_len, blank=0):
+    """Collapse-repeats-then-drop-blanks greedy decoding of a
+    ``(T*B, V)`` softmax output (host-side helper, numpy)."""
+    probs = np.asarray(probs)
+    TB, V = probs.shape
+    B = TB // seq_len
+    best = probs.reshape(seq_len, B, V).argmax(-1)            # (T, B)
+    out = []
+    for b in range(B):
+        seq, prev = [], -1
+        for t in range(seq_len):
+            k = int(best[t, b])
+            if k != prev and k != blank:
+                seq.append(k)
+            prev = k
+        out.append(seq)
+    return out

@@ -118,3 +118,7 @@ def test_bi_lstm_sort_example():
 
 def test_stochastic_depth_example():
     _run_example("stochastic-depth/sd_toy.py", "--epochs", "8")
+
+
+def test_warpctc_example():
+    _run_example("warpctc/toy_ctc.py", "--epochs", "35")

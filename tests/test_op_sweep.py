@@ -360,6 +360,10 @@ F("MAERegressionOutput", {"data": randn(3, 2), "label": randn(3, 2)},
 F("SVMOutput", {"data": randn(3, 4), "label": ints(4, 3)},
   fwd=lambda data, label: data)
 F("MakeLoss", {"data": pos(3, 2)}, fwd=lambda data: data)
+F("WarpCTC", {"data": randn(8, 5), "label": ints(4, 2, 3)},
+  {"label_length": 3, "input_length": 4},
+  fwd=lambda data, label: _sm(data))   # fwd = softmax; CTC grad is
+                                       # enumeration-checked in test_ctc.py
 F("softmax_cross_entropy", {"data": randn(3, 4), "label": ints(4, 3)},
   fwd=lambda data, label:
   np.array([-np.log(_sm(data))[np.arange(3), label.astype(int)].sum()],
