@@ -122,3 +122,15 @@ def test_stochastic_depth_example():
 
 def test_warpctc_example():
     _run_example("warpctc/toy_ctc.py", "--epochs", "35")
+
+
+def test_svm_example():
+    _run_example("svm_mnist/svm_toy.py", "--epochs", "10")
+
+
+def test_matrix_factorization_example():
+    _run_example("recommenders/matrix_fact_toy.py", "--epochs", "20")
+
+
+def test_sgld_example():
+    _run_example("bayesian-methods/sgld_toy.py", "--steps", "4000")
