@@ -365,6 +365,13 @@ inline std::vector<NDArray> UpSampling(
   return Invoke("UpSampling", inputs, kw);
 }
 
+inline std::vector<NDArray> WarpCTC(
+    const std::vector<NDArray> &inputs,
+    const KWArgs &extra = {}) {
+  KWArgs kw(extra);
+  return Invoke("WarpCTC", inputs, kw);
+}
+
 inline std::vector<NDArray> _CrossDeviceCopy(
     const std::vector<NDArray> &inputs,
     const KWArgs &extra = {}) {
