@@ -52,12 +52,15 @@ echo "=== driver entry points ==="
 python __graft_entry__.py
 python bench.py
 
-echo "=== inference zoo artifact (TPU only; bounded window) ==="
-# refreshes INFER_BENCH.json (reference perf.md scoring-table analog)
-# when a real chip is attached; CI without a TPU keeps the committed one
+echo "=== inference zoo scoring path (TPU only; bounded window) ==="
+# smoke-validates the scoring path when a chip is attached.  The CI
+# window is small AND the host is under full gate load, so the numbers
+# are NOT representative — the committed INFER_BENCH.json comes from a
+# dedicated idle-host run of the same command with default windows
+# (docs/how_to/perf.md documents the ±10% tunnel noise band even then).
 if python -c "import jax,sys; sys.exit(0 if jax.devices()[0].platform in ('tpu','axon') else 1)" 2>/dev/null; then
     python examples/image-classification/benchmark_score.py \
-        --batch-sizes 32 --num-batches 20 --out INFER_BENCH.json
+        --batch-sizes 32 --num-batches 20 --out /tmp/infer_bench_ci.json
 fi
 
 echo "CI OK"
