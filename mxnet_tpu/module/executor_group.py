@@ -13,6 +13,7 @@ from __future__ import annotations
 import logging
 
 import numpy as np
+import jax.numpy as jnp
 
 from ..base import MXNetError, current_context
 from ..executor_manager import _split_input_slice, _load_general
@@ -272,7 +273,13 @@ class DataParallelExecutorGroup(object):
 
     def update_metric(self, eval_metric, labels):
         for texec, islice in zip(self.execs, self.slices):
-            labels_slice = [NDArray(label.data[islice]) for label in labels]
+            # labels may be host-side numpy (e.g. an output="numpy"
+            # iterator feeding fit) — np.ndarray.data is a raw-buffer
+            # memoryview, NOT the value, so coerce before slicing
+            labels_slice = [
+                NDArray((label.data if isinstance(label, NDArray)
+                         else jnp.asarray(np.asarray(label)))[islice])
+                for label in labels]
             eval_metric.update(labels_slice, texec.outputs)
 
     def install_monitor(self, mon):
