@@ -259,3 +259,38 @@ def test_trainer_remat_env_default(monkeypatch):
     assert t.remat == "convs_dots"
     with pytest.raises(Exception):
         parallel.trainer.remat_policy("bogus")
+
+
+def test_trainer_remat_composes_with_mesh():
+    """Remat under a data-parallel mesh computes the same math as the
+    no-remat mesh trainer (the policies rewrite the backward, not the
+    sharding)."""
+    mesh = parallel.make_mesh({"data": 4})
+    rng = np.random.RandomState(9)
+    x = rng.randn(16, 4, 4, 3).astype("f")
+    y = (rng.rand(16) * 2).astype("int").astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.symbol.Convolution(data, num_filter=4, kernel=(3, 3),
+                                layout="NHWC", name="c1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.Flatten(net)
+    net = mx.symbol.FullyConnected(net, num_hidden=2, name="fc")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+
+    def run(remat):
+        t = parallel.Trainer(sym, mx.optimizer.create(
+            "sgd", learning_rate=0.1, rescale_grad=1.0 / 16),
+            mesh=mesh, remat=remat)
+        t.bind(data_shapes={"data": (16, 4, 4, 3)},
+               label_shapes={"softmax_label": (16,)})
+        mx.random.seed(21)
+        t.init_params(mx.init.Xavier())
+        for _ in range(3):
+            t.step({"data": x, "softmax_label": y})
+        return {n: np.asarray(v) for n, v in t.params.items()}
+
+    base = run("none")
+    test = run("convs_dots")
+    for n in base:
+        np.testing.assert_allclose(base[n], test[n], rtol=2e-5,
+                                   atol=2e-6, err_msg=n)
