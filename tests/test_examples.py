@@ -134,3 +134,7 @@ def test_matrix_factorization_example():
 
 def test_sgld_example():
     _run_example("bayesian-methods/sgld_toy.py", "--steps", "4000")
+
+
+def test_dec_example():
+    _run_example("dec/dec_toy.py", "--rounds", "40")
