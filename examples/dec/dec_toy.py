@@ -65,12 +65,6 @@ def dec_symbol():
     return mx.sym.Group([mx.sym.BlockGrad(q), loss])
 
 
-def soft_assign(z, mu):
-    d2 = ((z[:, None, :] - mu[None]) ** 2).sum(-1)
-    qu = (1.0 + d2 / ALPHA) ** (-(ALPHA + 1.0) / 2.0)
-    return qu / qu.sum(1, keepdims=True)
-
-
 def target_distribution(q):
     """P = sharpened Q with per-cluster frequency normalization
     (reference refresh())."""
@@ -158,6 +152,8 @@ def main(update_interval=4, rounds=40):
                                          "momentum": 0.9,
                                          "rescale_grad": 1.0 / batch})
 
+    if rounds < 1:
+        raise SystemExit("--rounds must be >= 1")
     p = None
     for r in range(rounds):
         dummy = mx.io.DataBatch(

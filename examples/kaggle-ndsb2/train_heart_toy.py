@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Kaggle NDSB-2 heart-volume pipeline (reference
+``example/kaggle-ndsb2/Train.py``): a LeNet-style net over the
+DIFFERENCES of consecutive frames (``SliceChannel`` + subtract +
+``Concat``), a cumulative-distribution head (20 bins here, 600 in the
+reference) trained with ``LogisticRegressionOutput``, and the
+competition's CRPS metric as a ``CustomMetric``.
+
+The synthetic "cine MRI": a pulsing disc whose radius oscillates over
+8 frames; the label is the CDF step vector of its peak area.  Frame
+differencing is the point — a single frame can't tell amplitude, the
+motion between frames can.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import mxnet_tpu as mx                                      # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+FRAMES, SIDE, BINS = 8, 24, 20
+
+
+def get_net():
+    source = mx.sym.Variable("data")
+    frames = mx.sym.SliceChannel(source, num_outputs=FRAMES)
+    diffs = [frames[i + 1] - frames[i] for i in range(FRAMES - 1)]
+    net = mx.sym.Concat(*diffs)
+    net = mx.sym.Convolution(net, kernel=(5, 5), num_filter=16,
+                             name="conv1")
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Convolution(net, kernel=(3, 3), num_filter=16,
+                             name="conv2")
+    net = mx.sym.BatchNorm(net, fix_gamma=True)
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Pooling(net, pool_type="max", kernel=(2, 2),
+                         stride=(2, 2))
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=64)
+    net = mx.sym.Activation(net, act_type="relu")
+    fc = mx.sym.FullyConnected(net, num_hidden=BINS)
+    # sigmoid head per CDF bin, like the reference's 600-bin head
+    return mx.sym.LogisticRegressionOutput(fc, name="softmax")
+
+
+def crps(label, pred):
+    """Continuous Ranked Probability Score over the CDF bins (the
+    reference's ``CRPS`` numpy feval, Train.py)."""
+    return float(np.mean((label - pred) ** 2))
+
+
+def make_data(n, seed):
+    rng = np.random.RandomState(seed)
+    amp = rng.uniform(3.0, 9.0, n)                      # peak radius
+    yy, xx = np.mgrid[:SIDE, :SIDE]
+    x = np.zeros((n, FRAMES, SIDE, SIDE), "f")
+    for i in range(n):
+        phase = rng.uniform(0, np.pi)
+        for t in range(FRAMES):
+            r = 2.0 + (amp[i] - 2.0) * 0.5 * (
+                1 + np.sin(phase + 2 * np.pi * t / FRAMES))
+            x[i, t] = np.hypot(yy - SIDE / 2, xx - SIDE / 2) < r
+    x += rng.normal(0, 0.1, x.shape).astype("f")
+    # CDF step labels: bin b is 1 iff peak_area <= bin edge b
+    area = np.pi * amp ** 2
+    edges = np.linspace(np.pi * 9, np.pi * 81, BINS)
+    y = (area[:, None] <= edges[None, :]).astype("f")
+    return x.astype("f"), y
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=32)
+    args = ap.parse_args(argv)
+
+    xt, yt = make_data(512, 0)
+    xv, yv = make_data(128, 1)
+    train = mx.io.NDArrayIter(xt, yt, args.batch_size, shuffle=True)
+    val = mx.io.NDArrayIter(xv, yv, args.batch_size)
+
+    metric = mx.metric.np(crps, name="crps")
+    mod = mx.mod.Module(get_net(), context=mx.cpu())
+    mod.fit(train, eval_data=val, eval_metric=metric,
+            num_epoch=args.epochs, optimizer="adam",
+            optimizer_params={"learning_rate": 0.002},
+            initializer=mx.init.Xavier())
+
+    val.reset()
+    score = mod.score(val, mx.metric.np(crps, name="crps"))[0][1]
+    logging.info("validation CRPS: %.4f", score)
+    # an untrained net sits at ~0.25 (sigmoid 0.5 vs 0/1 steps);
+    # learning the pulse amplitude drives it well under 0.1
+    assert score < 0.1, score
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""SequentialModule + PythonLossModule how-to (reference
+``example/module/python_loss.py`` / ``sequential_module.py``): a
+symbolic MLP stage chained to a HOST-side loss whose gradient is plain
+numpy — the multi-class hinge loss — with SequentialModule wiring the
+stages and routing labels to the loss stage.
+
+The host-side gradient is the point: everything before the loss still
+runs as one compiled XLA program; only the terminal ``grad_func`` runs
+in Python, exactly like the reference's numba-jitted hinge grad.
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import mxnet_tpu as mx                                      # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+
+def mc_hinge_grad(scores, labels):
+    """d/dscores of the Crammer-Singer multi-class hinge loss."""
+    s = scores.asnumpy()
+    y = labels.asnumpy().astype(int)
+    n = s.shape[0]
+    margin = 1.0 + s - s[np.arange(n), y][:, None]
+    margin[np.arange(n), y] = 0.0
+    pred = margin.argmax(1)
+    grad = np.zeros_like(s)
+    viol = margin[np.arange(n), pred] > 0
+    grad[viol, y[viol]] -= 1.0
+    grad[viol, pred[viol]] += 1.0
+    return grad / n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=100)
+    args = ap.parse_args(argv)
+
+    rng = np.random.RandomState(0)
+    protos = rng.normal(0, 1, (10, 64))
+    y = rng.randint(0, 10, 2000)
+    x = (protos[y] + rng.normal(0, 0.6, (2000, 64))).astype("f")
+    it = mx.io.NDArrayIter(x, y.astype("f"), args.batch_size,
+                           shuffle=True)
+
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    scores = mx.sym.FullyConnected(net, name="fc2", num_hidden=10)
+
+    mlp = mx.mod.Module(scores, label_names=(), context=mx.cpu())
+    loss = mx.mod.PythonLossModule(grad_func=mc_hinge_grad)
+    mod = mx.mod.SequentialModule() \
+        .add(mlp) \
+        .add(loss, take_labels=True, auto_wiring=True)
+
+    mod.fit(it, num_epoch=args.epochs,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.2, "momentum": 0.9},
+            initializer=mx.init.Xavier(),
+            eval_metric="acc",
+            batch_end_callback=mx.callback.Speedometer(args.batch_size,
+                                                       10))
+    it.reset()
+    acc = mod.score(it, "acc")[0][1]
+    logging.info("hinge-trained accuracy: %.3f", acc)
+    assert acc > 0.9, acc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Python how-tos (reference ``example/python-howto/``), condensed into
+one runnable script with an assertion per topic:
+
+1. ``data_iter``     — a custom ``DataIter`` subclass feeding ``fit()``
+                       (reference ``data_iter.py``: configuring an
+                       augmenting RecordIO iterator; here the subject
+                       is the iterator *protocol* itself).
+2. ``multiple_outputs`` — ``Group`` symbols: bind once, read internal
+                       AND final outputs (``multiple_outputs.py``).
+3. ``monitor_weights`` — installing a ``Monitor`` that reports a norm
+                       statistic per array during training
+                       (``monitor_weights.py``).
+4. ``debug_conv``    — stepping a conv executor node-by-node with
+                       ``partial_forward`` (``debug_conv.py``'s
+                       inspect-the-activations workflow).
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import mxnet_tpu as mx                                      # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+
+class XorIter(mx.io.DataIter):
+    """Minimal custom iterator: the full protocol is provide_data /
+    provide_label / next() raising StopIteration / reset()."""
+
+    def __init__(self, batch_size=32, batches=10, seed=0):
+        super().__init__(batch_size)
+        rng = np.random.RandomState(seed)
+        self._x = rng.randint(0, 2, (batches * batch_size, 2))
+        self._y = (self._x[:, 0] ^ self._x[:, 1]).astype("f")
+        self._x = (self._x + rng.normal(0, 0.1,
+                                        self._x.shape)).astype("f")
+        self._cur, self._batches = 0, batches
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (self.batch_size, 2))]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur >= self._batches:
+            raise StopIteration
+        s = self._cur * self.batch_size
+        self._cur += 1
+        return mx.io.DataBatch(
+            data=[mx.nd.array(self._x[s:s + self.batch_size])],
+            label=[mx.nd.array(self._y[s:s + self.batch_size])],
+            pad=0)
+
+
+def howto_data_iter():
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=8,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(XorIter(), num_epoch=25, optimizer="adam",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Xavier())
+    acc = mod.score(XorIter(seed=7), "acc")[0][1]
+    logging.info("custom-iterator XOR accuracy: %.3f", acc)
+    assert acc > 0.95, acc
+
+
+def howto_multiple_outputs():
+    data = mx.sym.Variable("data")
+    fc1 = mx.sym.FullyConnected(data, name="fc1", num_hidden=16)
+    net = mx.sym.Activation(fc1, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=4)
+    out = mx.sym.SoftmaxOutput(net, name="softmax")
+    group = mx.sym.Group([fc1, out])
+    logging.info("group outputs: %s", group.list_outputs())
+    exe = group.simple_bind(ctx=mx.cpu(), data=(3, 8),
+                            softmax_label=(3,))
+    for name, arr in exe.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr[:] = 0.1
+    exe.arg_dict["data"][:] = np.ones((3, 8), "f")
+    exe.arg_dict["softmax_label"][:] = 0
+    exe.forward(is_train=False)
+    assert exe.outputs[0].shape == (3, 16)       # fc1 internal output
+    assert exe.outputs[1].shape == (3, 4)        # softmax output
+    np.testing.assert_allclose(exe.outputs[1].asnumpy().sum(1),
+                               np.ones(3), rtol=1e-5)
+
+
+def howto_monitor_weights():
+    def norm_stat(d):
+        return mx.nd.norm(d) / np.sqrt(d.size)
+
+    seen = []
+    mon = mx.mon.Monitor(5, norm_stat, sort=True)
+    orig_toc = mon.toc
+
+    def capture():
+        rows = orig_toc()
+        seen.extend(rows)
+        return rows
+    mon.toc = capture
+
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    rng = np.random.RandomState(0)
+    it = mx.io.NDArrayIter(rng.rand(64, 8).astype("f"),
+                           rng.randint(0, 4, 64).astype("f"), 16)
+    mod.fit(it, num_epoch=3, monitor=mon, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1},
+            initializer=mx.init.Uniform(0.1))
+    logging.info("monitor captured %d stats; sample: %s", len(seen),
+                 seen[:2])
+    assert any("fc_weight" in str(row) for row in seen), seen[:5]
+
+
+def howto_debug_conv():
+    data = mx.sym.Variable("data")
+    conv = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4,
+                              pad=(1, 1), name="conv")
+    act = mx.sym.Activation(conv, act_type="relu", name="relu")
+    exe = act.simple_bind(ctx=mx.cpu(), data=(1, 2, 8, 8))
+    exe.arg_dict["data"][:] = np.random.RandomState(0).rand(1, 2, 8, 8)
+    exe.arg_dict["conv_weight"][:] = 0.1
+    exe.arg_dict["conv_bias"][:] = -0.5
+    steps = 0
+    while exe.partial_forward(step=steps) > 0:  # node-by-node forward
+        steps += 1
+    nodes_run = steps + 1                       # step indices are 0-based
+    out = exe.outputs[0].asnumpy()
+    logging.info("stepped %d graph nodes; relu output min=%.3f",
+                 nodes_run, out.min())
+    assert nodes_run >= 2 and out.min() >= 0.0
+
+
+def main():
+    howto_data_iter()
+    howto_multiple_outputs()
+    howto_monitor_weights()
+    howto_debug_conv()
+    print("all python-howto topics passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

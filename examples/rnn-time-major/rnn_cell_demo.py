@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Time-major RNN layout (reference
+``example/rnn-time-major/rnn_cell_demo.py``): unroll the same LSTM in
+``TNC`` (time, batch, channel) vs ``NTC`` layout on a toy
+sequence-labeling task, verify both learn, and time an epoch of each.
+
+The reference measured time-major 1.5-2x faster on GPU because cuDNN
+slices are contiguous per step.  On TPU the unroll compiles to one XLA
+program either way and the layout choice costs at most a transpose —
+this demo prints both rates so you can see the gap is gone, and checks
+the two layouts agree numerically given the same parameters.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import mxnet_tpu as mx                                      # noqa: E402
+
+logging.basicConfig(level=logging.INFO)
+
+SEQ, BATCH, VOCAB, HIDDEN, EMBED = 12, 32, 16, 32, 16
+
+
+def build(layout):
+    """Shift-by-one prediction over a random-walk token stream."""
+    data = mx.sym.Variable("data")          # NTC: (N,T); TNC: (T,N)
+    label = mx.sym.Variable("softmax_label")
+    embed = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                             name="embed")
+    cell = mx.rnn.LSTMCell(HIDDEN, prefix="lstm_")
+    cell.reset()
+    outputs, _ = cell.unroll(SEQ, inputs=embed, layout=layout,
+                             merge_outputs=True)
+    pred = mx.sym.Reshape(outputs, shape=(-1, HIDDEN))
+    pred = mx.sym.FullyConnected(pred, num_hidden=VOCAB, name="pred")
+    label = mx.sym.Reshape(label, shape=(-1,))
+    return mx.sym.SoftmaxOutput(pred, label, name="softmax")
+
+
+def make_data(n, seed=0):
+    rng = np.random.RandomState(seed)
+    x = np.zeros((n, SEQ), "f")
+    x[:, 0] = rng.randint(0, VOCAB, n)
+    for t in range(1, SEQ):                  # deterministic +1 walk
+        x[:, t] = (x[:, t - 1] + 1) % VOCAB
+    y = (x + 1) % VOCAB                      # predict the next token
+    return x, y
+
+
+def run(layout, epochs):
+    x, y = make_data(640)
+    if layout == "TNC":
+        x, y = x.T.copy(), y.T.copy()
+        data_shape, label_shape = (SEQ, BATCH), (SEQ, BATCH)
+        # NDArrayIter batches over axis 0; for time-major feed we batch
+        # over the TIME axis' companion by supplying full TNC slabs
+        it = TimeMajorIter(x, y, BATCH)
+    else:
+        data_shape, label_shape = (BATCH, SEQ), (BATCH, SEQ)
+        it = mx.io.NDArrayIter(x, y, BATCH, shuffle=False,
+                               label_name="softmax_label")
+    mod = mx.mod.Module(build(layout), context=mx.cpu())
+    mod.bind(data_shapes=[("data", data_shape)],
+             label_shapes=[("softmax_label", label_shape)])
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.01})
+    metric = mx.metric.create("acc")
+    t0 = time.perf_counter()
+    samples = 0
+    for _ in range(epochs):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+            samples += BATCH
+    rate = samples / (time.perf_counter() - t0)
+    acc = metric.get()[1]
+    logging.info("%s: %.1f samples/s, final-epoch acc %.3f", layout,
+                 rate, acc)
+    return acc, mod.get_params()
+
+
+def check_layout_agreement(arg_params, aux_params):
+    """Same parameters, same sequences, both layouts: the per-token
+    probabilities must agree — the layout is a data arrangement, not a
+    different model."""
+    x, _ = make_data(BATCH, seed=9)
+    outs = {}
+    for layout in ("NTC", "TNC"):
+        xin = x if layout == "NTC" else x.T.copy()
+        shape = (BATCH, SEQ) if layout == "NTC" else (SEQ, BATCH)
+        mod = mx.mod.Module(build(layout), context=mx.cpu())
+        mod.bind(data_shapes=[("data", shape)],
+                 label_shapes=[("softmax_label", shape)],
+                 for_training=False)
+        mod.set_params(arg_params, aux_params)
+        mod.forward(mx.io.DataBatch(data=[mx.nd.array(xin)],
+                                    label=[mx.nd.array(
+                                        np.zeros(shape, "f"))]),
+                    is_train=False)
+        probs = mod.get_outputs()[0].asnumpy()
+        # unroll emits (batch*T, vocab) rows in layout order; map both
+        # to (N, T, V) for comparison
+        if layout == "NTC":
+            outs[layout] = probs.reshape(BATCH, SEQ, VOCAB)
+        else:
+            outs[layout] = probs.reshape(SEQ, BATCH, VOCAB) \
+                .transpose(1, 0, 2)
+    np.testing.assert_allclose(outs["NTC"], outs["TNC"], rtol=1e-4,
+                               atol=1e-5)
+    logging.info("layout agreement check passed (max abs diff %.2e)",
+                 np.abs(outs["NTC"] - outs["TNC"]).max())
+
+
+class TimeMajorIter(mx.io.DataIter):
+    """Slices (T, N_total) arrays along the BATCH axis (axis 1)."""
+
+    def __init__(self, x, y, batch_size):
+        super().__init__(batch_size)
+        self._x, self._y, self._cur = x, y, 0
+
+    @property
+    def provide_data(self):
+        return [mx.io.DataDesc("data", (SEQ, self.batch_size),
+                               layout="TN")]
+
+    @property
+    def provide_label(self):
+        return [mx.io.DataDesc("softmax_label", (SEQ, self.batch_size),
+                               layout="TN")]
+
+    def reset(self):
+        self._cur = 0
+
+    def next(self):
+        if self._cur + self.batch_size > self._x.shape[1]:
+            raise StopIteration
+        s = slice(self._cur, self._cur + self.batch_size)
+        self._cur += self.batch_size
+        return mx.io.DataBatch(data=[mx.nd.array(self._x[:, s])],
+                               label=[mx.nd.array(self._y[:, s])], pad=0)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--epochs", type=int, default=8)
+    args = ap.parse_args(argv)
+    acc_tnc, (arg_p, aux_p) = run("TNC", args.epochs)
+    acc_ntc, _ = run("NTC", args.epochs)
+    assert acc_tnc > 0.95 and acc_ntc > 0.95, (acc_tnc, acc_ntc)
+    check_layout_agreement(arg_p, aux_p)
+    print("both layouts learned the walk (TNC %.3f, NTC %.3f) and "
+          "agree numerically" % (acc_tnc, acc_ntc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

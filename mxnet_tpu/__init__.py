@@ -102,6 +102,7 @@ from . import module
 from . import module as mod
 from .module import Module
 from . import monitor
+from . import monitor as mon
 from .monitor import Monitor
 from . import test_utils
 from . import visualization

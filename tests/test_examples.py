@@ -138,3 +138,39 @@ def test_sgld_example():
 
 def test_dec_example():
     _run_example("dec/dec_toy.py", "--rounds", "40")
+
+
+def test_memcost_example():
+    _run_example("memcost/inception_memcost.py")
+
+
+def test_module_mnist_mlp_example():
+    _run_example("module/mnist_mlp.py", "--epochs", "4")
+
+
+def test_module_python_loss_example():
+    _run_example("module/python_loss.py", "--epochs", "6")
+
+
+def test_profiler_example():
+    _run_example("profiler/profiler_matmul.py")
+
+
+def test_python_howto_example():
+    _run_example("python-howto/howtos.py")
+
+
+def test_rnn_time_major_example():
+    _run_example("rnn-time-major/rnn_cell_demo.py", "--epochs", "6")
+
+
+def test_kaggle_ndsb1_example():
+    _run_example("kaggle-ndsb1/train_dsb_toy.py", "--epochs", "4")
+
+
+def test_kaggle_ndsb2_example():
+    _run_example("kaggle-ndsb2/train_heart_toy.py", "--epochs", "8")
+
+
+def test_speech_demo_example():
+    _run_example("speech-demo/train_acoustic_toy.py", "--epochs", "5")
