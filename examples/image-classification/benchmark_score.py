@@ -20,24 +20,38 @@ logging.basicConfig(level=logging.INFO)
 
 
 def score(network, batch_size, image_shape=(3, 224, 224), num_batches=None,
-          dtype="float32"):
-    # scale the timed window inversely with batch size so fixed
-    # per-dispatch costs (~3 ms tunnel jitter + tail sync) stay a small
-    # fraction of it; note small-batch rows on a REMOTE chip remain
+          dtype="float32", min_seconds=4.0):
+    # a fixed batch count gave fast nets (alexnet batch 32: ~0.3 s
+    # timed) windows dominated by dispatch jitter — observed 2x swings
+    # between identical runs.  Time-based window instead: repeat until
+    # >= min_seconds measured.  An explicit num_batches (CI) stays
+    # exact and bounded.  Small-batch rows on a REMOTE chip remain
     # partly latency-bound by nature — the tunnel round-trip is real
-    # serving latency there
-    if num_batches is None:
+    # serving latency there.
+    fixed = num_batches is not None
+    if not fixed:
         num_batches = max(50, 1600 // batch_size)
     sym = models.get_symbol(network, num_classes=1000)
     data_shape = (batch_size,) + image_shape
     mod = mx.mod.Module(symbol=sym, context=mx.tpu())
+    # TPU-native serving tier: binding with a bf16 DataDesc makes type
+    # inference allocate the EXECUTOR arrays (params included) in bf16,
+    # so matmuls/convs run at MXU rate and weight traffic is halved —
+    # a post-bind set_params cast would be silently undone by copyto's
+    # cast-to-destination.  The reference's analog is the fp16 symbol
+    # variants (symbols/alexnet_fp16.py, resnet_fp16.py).
     mod.bind(for_training=False, inputs_need_grad=False,
-             data_shapes=[mx.io.DataDesc("data", data_shape)])
+             data_shapes=[mx.io.DataDesc("data", data_shape,
+                                         np.dtype(dtype))])
     mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+    bound = str(mod._exec_group.execs[0].arg_dict["data"].dtype)
+    if bound != dtype:           # survives python -O, unlike assert
+        raise RuntimeError("requested %s but executor bound %s — the "
+                           "dtype was silently undone" % (dtype, bound))
     rng = np.random.RandomState(0)
     batch = mx.io.DataBatch(
-        data=[mx.nd.array(rng.uniform(-1, 1, data_shape)
-                          .astype(dtype))], label=[])
+        data=[mx.nd.array(rng.uniform(-1, 1, data_shape))
+              .astype(dtype)], label=[])
 
     def sync():
         # scalar fetch = completion barrier (block_until_ready is a
@@ -47,11 +61,15 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=None,
     for _ in range(10):                      # compile + pipeline warmup
         mod.forward(batch, is_train=False)
     sync()
-    tic = time.time()
-    for _ in range(num_batches):
-        mod.forward(batch, is_train=False)
-    sync()
-    return num_batches * batch_size / (time.time() - tic)
+    total, tic = 0, time.time()
+    while True:
+        for _ in range(num_batches):
+            mod.forward(batch, is_train=False)
+        sync()
+        total += num_batches
+        if fixed or time.time() - tic >= min_seconds:
+            break
+    return total * batch_size / (time.time() - tic)
 
 
 # reference P100 batch-32 scoring rows (the zoo table this framework
@@ -67,6 +85,10 @@ def main(argv=None):
                         default="alexnet,vgg,inception-bn,inception-v3,"
                                 "resnet-50,resnet-152")
     parser.add_argument("--batch-sizes", type=str, default="1,32")
+    parser.add_argument("--dtypes", type=str, default="float32",
+                        help="comma list; bfloat16 adds the TPU-native "
+                             "serving tier (params + input cast, halved "
+                             "weight traffic)")
     parser.add_argument("--num-batches", type=int, default=None,
                         help="override the timed window (CI uses a small "
                              "bounded one; default scales with batch)")
@@ -77,20 +99,22 @@ def main(argv=None):
     rows = []
     for net in args.networks.split(","):
         for b in (int(x) for x in args.batch_sizes.split(",")):
-            speed = score(net, b, num_batches=args.num_batches)
-            logging.info("network: %s, batch size: %d, image/sec: %.2f",
-                         net, b, speed)
-            row = {"network": net, "batch_size": b,
-                   "img_per_sec": round(speed, 2)}
-            if b == 32 and net in P100_BATCH32:
-                row["p100_img_per_sec"] = P100_BATCH32[net]
-                row["vs_p100"] = round(speed / P100_BATCH32[net], 2)
-            rows.append(row)
+            for dt in args.dtypes.split(","):
+                speed = score(net, b, num_batches=args.num_batches,
+                              dtype=dt)
+                logging.info("network: %s, batch size: %d, dtype: %s, "
+                             "image/sec: %.2f", net, b, dt, speed)
+                row = {"network": net, "batch_size": b, "dtype": dt,
+                       "img_per_sec": round(speed, 2)}
+                if b == 32 and net in P100_BATCH32:
+                    row["p100_img_per_sec"] = P100_BATCH32[net]
+                    row["vs_p100"] = round(speed / P100_BATCH32[net], 2)
+                rows.append(row)
     if args.out:
         import json
         import jax
         artifact = {"device": str(jax.devices()[0].device_kind),
-                    "dtype": "float32", "rows": rows}
+                    "dtypes": args.dtypes.split(","), "rows": rows}
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=1)
         print(json.dumps({"rows": len(rows), "out": args.out}))

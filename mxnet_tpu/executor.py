@@ -59,6 +59,11 @@ class _GraphProgram:
         self._aux_index = {n: i for i, n in enumerate(self.aux_names)}
         self.has_rng = any((not n.is_variable) and n.op.uses_rng
                            for n in self.nodes)
+        # eval-mode forward only needs a fresh key when some op draws
+        # at is_train=False (samplers); Dropout-style train-only noise
+        # must not cost per-forward key derivation in inference
+        self.has_eval_rng = any((not n.is_variable) and n.op.uses_rng
+                                and n.op.rng_in_eval for n in self.nodes)
         # target backend for platform-specialized op lowerings
         self.platform = None
         # group2ctx placement: node name -> jax device.  The TPU analog
@@ -162,6 +167,8 @@ class Executor:
         self._outputs: List[NDArray] = []
         self._vjp = None
         self._monitor = None
+        self._const_key = None      # cached rng key for rng-free programs
+        self._const_key_dev = None
         self._partial = None      # partial_forward's carried env
         self._partial_done = False  # a sequence ran to completion
         self._rng_counter = 0
@@ -175,11 +182,17 @@ class Executor:
         return dict(zip(self._symbol.list_outputs(), self._outputs))
 
     # ------------------------------------------------------------------
-    def _next_key(self):
+    def _next_key(self, is_train=True):
         from . import random as _random
-        if self._prog.has_rng:
+        if (self._prog.has_rng and is_train) or self._prog.has_eval_rng:
             return _random.next_key()
-        return jax.random.key(0)
+        # the key is a dead argument this mode (rng-free program, or
+        # train-only noise ops at is_train=False) — build and place it
+        # ONCE (each jax.random.key / fold_in / device_put is a
+        # dispatched op, pure latency on a tunneled chip)
+        if self._const_key is None:
+            self._const_key = jax.random.key(0)
+        return self._const_key
 
     def _eager_committed(self, vals):
         """Pin values for the eager per-node paths (monitor, partial
@@ -207,8 +220,18 @@ class Executor:
                 self.arg_dict[k]._sync_copyfrom(v)
         arg_vals = tuple(a.data for a in self.arg_arrays)
         aux_vals = tuple(a.data for a in self.aux_arrays)
-        key = self._next_key()
-        if arg_vals:
+        key = self._next_key(is_train)
+        if arg_vals and key is self._const_key:
+            # const key: placement is one-time too (see _next_key)
+            try:
+                dev = list(arg_vals[0].devices())[0]
+                if self._const_key_dev is not dev:
+                    self._const_key = jax.device_put(key, dev)
+                    self._const_key_dev = dev
+                key = self._const_key
+            except Exception:
+                pass
+        elif arg_vals:
             try:  # co-locate the key with this executor's device
                 key = jax.device_put(key, list(arg_vals[0].devices())[0])
             except Exception:
@@ -274,7 +297,7 @@ class Executor:
             self._partial = (
                 env,
                 self._eager_committed([a.data for a in self.aux_arrays]),
-                self._next_key(), 0)
+                self._next_key(is_train), 0)
         if self._partial is None or self._partial[3] != step:
             raise MXNetError(
                 "partial_forward steps must be issued in order from 0 "

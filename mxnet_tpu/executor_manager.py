@@ -61,6 +61,14 @@ def _load_general(data, targets):
         elif isinstance(d_src, nd.NDArray):
             # slice on-device (XLA slice): no host round trip per batch
             for slice_idx, d_dst in d_targets:
+                if (d_src.dtype == d_dst.dtype
+                        and tuple(d_src.shape) == tuple(d_dst.shape)
+                        and d_src.context == d_dst.context):
+                    # single-executor fast path: whole batch, same dtype
+                    # and device — adopt the buffer, zero dispatched ops
+                    # (on a tunneled chip every dispatch is latency)
+                    d_dst._set_data(d_src.data)
+                    continue
                 piece = d_src.data[slice_idx].astype(d_dst.dtype)
                 if tuple(piece.shape) != tuple(d_dst.shape):
                     raise MXNetError(

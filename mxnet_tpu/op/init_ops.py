@@ -66,7 +66,7 @@ def _sample_spec(*extra):
 
 def _reg_sampler(name, spec, fn, aliases=()):
     register(name, fn, params_spec=_sample_spec(*spec), input_names=(),
-             uses_rng=True)
+             uses_rng=True, rng_in_eval=True)
     for al in aliases:
         alias(al, name)
 

@@ -140,6 +140,11 @@ class Op:
     infer_shape: Optional[Callable] = None  # (params, in_shapes) -> (in,out,aux)
     infer_dtype: Optional[Callable] = None
     uses_rng: bool = False
+    # rng consumed even at is_train=False (samplers).  Train-only noise
+    # ops (Dropout, rrelu, RNN dropout) leave this False so an
+    # inference executor never pays per-forward key derivation — on a
+    # tunneled chip each eager key op is a round trip
+    rng_in_eval: bool = False
     mode_dependent: bool = False  # retrace per is_train value
     hint: str = ""  # auto-naming hint, defaults to lowercased name
     # ops whose outputs must not be differentiated through label-style inputs
