@@ -66,6 +66,15 @@ def test_visualization_summary(capsys):
     assert "fc" in out and "Total params" in out
 
 
+def test_visualization_plot_network():
+    pytest.importorskip("graphviz")
+    dot = mx.viz.plot_network(_tiny_net(), shape={"data": (1, 6)},
+                              title="tiny")
+    src = dot.source
+    assert "fc" in src and "softmax" in src
+    assert "1x6" in src or "6" in src     # shape labels on edges
+
+
 def test_feedforward_legacy_api():
     X, Y = _tiny_data()
     model = mx.model.FeedForward(_tiny_net(), num_epoch=8,

@@ -174,3 +174,37 @@ def test_partial_forward_cold_out_of_range_raises():
     ex.forward(is_train=False)
     with pytest.raises(Exception):
         ex.partial_forward(is_train=False, step=99)
+
+
+def test_eval_forward_skips_key_derivation(monkeypatch):
+    """Train-only noise ops (Dropout) must not cost per-forward PRNG
+    derivation at is_train=False — on a tunneled chip every eager key
+    op is a dispatch round trip (the round-4 inference fix).  Samplers
+    (rng_in_eval) must still draw fresh keys every forward."""
+    from mxnet_tpu import random as mxrandom
+
+    calls = {"n": 0}
+    real = mxrandom.next_key
+
+    def counting_next_key():
+        calls["n"] += 1
+        return real()
+    monkeypatch.setattr(mxrandom, "next_key", counting_next_key)
+
+    net = mx.sym.Dropout(mx.sym.Variable("data"), p=0.5)
+    ex = net.simple_bind(mx.cpu(), grad_req="null", data=(2, 8))
+    ex.arg_dict["data"][:] = np.ones((2, 8), "f")
+    for _ in range(3):
+        ex.forward(is_train=False)
+    assert calls["n"] == 0, "eval forward of a train-only-noise " \
+        "program must reuse the cached const key"
+    ex.forward(is_train=True)
+    assert calls["n"] == 1, "train forward must derive a fresh key"
+
+    calls["n"] = 0
+    samp = mx.sym.Group([mx.sym.uniform(shape=(2, 2))])
+    sex = samp.simple_bind(mx.cpu(), grad_req="null")
+    a = sex.forward(is_train=False)[0].asnumpy().copy()
+    b = sex.forward(is_train=False)[0].asnumpy().copy()
+    assert calls["n"] == 2, "sampler eval forwards must draw fresh keys"
+    assert not np.allclose(a, b), "sampler eval draws must differ"
