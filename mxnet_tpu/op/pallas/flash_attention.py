@@ -58,12 +58,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
 
     @pl.when(live)
     def _update():
-        qb = q_ref[0].astype(jnp.float32) * scale
-        kblk = k_ref[0].astype(jnp.float32)
-        vblk = v_ref[0].astype(jnp.float32)
+        # matmul INPUTS stay in the storage dtype (bf16): casting them
+        # to f32 first would force multi-pass f32 MXU kernels at a
+        # fraction of bf16 rate; preferred_element_type keeps the
+        # ACCUMULATION in f32, and the softmax scale is applied to the
+        # f32 scores so no precision is lost to bf16 pre-scaling
+        qb = q_ref[0]
+        kblk = k_ref[0]
         s = jax.lax.dot_general(
             qb, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [block_q, block_k]
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
         q_pos = first_q + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 0)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
@@ -81,8 +85,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         p = jnp.where(mask, p, 0.0)
         corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_safe))
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # PV at bf16 MXU rate too: P is in [0,1] post-softmax, so the
+        # bf16 cast costs ~2^-9 relative — inside the bf16 pipeline's
+        # own noise (the f32 path would be 4x+ slower on the MXU)
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-            p, vblk, (((1,), (0,)), ((), ())),
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
@@ -220,7 +227,7 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=512, block_k=512, interpret=None):
     """Memory-efficient exact attention.
 
     Args: ``q`` [b, t_q, h, d], ``k``/``v`` [b, t_kv, h, d] (the
@@ -235,8 +242,16 @@ def flash_attention(q, k, v, causal=False, scale=None,
     t_kv = k.shape[1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    block_q = min(block_q, max(t_q, 1))
-    block_k = min(block_k, max(t_kv, 1))
+
+    def clamp(block, t):
+        # clamp to the sequence but keep the block LANE-ALIGNED: a raw
+        # min(block, t) for 128 < t < block would hand Mosaic a
+        # non-tile-multiple block shape (t=300 -> (300, d) blocks);
+        # rounding t up to a 128 multiple keeps one aligned block and
+        # the _pad_time path pads the array to match
+        return min(block, -(-max(t, 1) // _LANES) * _LANES)
+    block_q = clamp(block_q, t_q)
+    block_k = clamp(block_k, t_kv)
 
     def fold(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
