@@ -24,6 +24,14 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow_example: multi-minute example training; the fast CI gate "
+        "skips these (ci/run_tests.sh runs them under MXTPU_CI_FULL=1, "
+        "as does the nightly)")
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     np.random.seed(0)
