@@ -14,9 +14,10 @@
 #                        2 + TPU-attached consistency/bench/inference
 #                        ~20); ~15 min without a chip.
 #   MXTPU_CI_FULL=1    — everything: all 25+ example trainings run
-#                        end-to-end (adds ~35-40 min serial on 1 core;
-#                        a multi-core host parallelizes the example
-#                        subprocesses).  This is the nightly tier.
+#                        end-to-end.  Measured: 64 min total with a
+#                        chip (42 min unit stage); a multi-core host
+#                        parallelizes the example subprocesses.  This
+#                        is the nightly tier.
 # Each stage echoes a timestamp so wall-time regressions are visible
 # in the log.  Quick iteration while developing:
 #   python -m pytest tests/ -x -q -k "not examples and not lowp"
@@ -73,7 +74,8 @@ stage "inference zoo scoring path (TPU only; bounded window)"
 # (docs/how_to/perf.md documents the ±10% tunnel noise band even then).
 if python -c "import jax,sys; sys.exit(0 if jax.devices()[0].platform in ('tpu','axon') else 1)" 2>/dev/null; then
     python examples/image-classification/benchmark_score.py \
-        --batch-sizes 32 --num-batches 20 --out /tmp/infer_bench_ci.json
+        --batch-sizes 32 --num-batches 20 --dtypes float32,int8 \
+        --out /tmp/infer_bench_ci.json
 fi
 
 stage "CI OK"

@@ -33,6 +33,24 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=None,
         num_batches = max(50, 1600 // batch_size)
     sym = models.get_symbol(network, num_classes=1000)
     data_shape = (batch_size,) + image_shape
+    # "int8" tier = weights-only int8 storage + bf16 compute (the
+    # mx.contrib.quantization serving config): weight HBM reads drop to
+    # 1 byte/elem while the MXU computes in bf16
+    quant = dtype == "int8"
+    serve_dtype = "bfloat16" if quant else dtype
+    if quant:
+        # float init + quantization are host-side: bind the throwaway
+        # init module on CPU so no second weight set or executor sits
+        # in TPU HBM during the timed window
+        fmod = mx.mod.Module(symbol=sym, context=mx.cpu())
+        fmod.bind(for_training=False, inputs_need_grad=False,
+                  data_shapes=[mx.io.DataDesc("data", data_shape)])
+        fmod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+        from mxnet_tpu.contrib.quantization import quantize_model
+        arg_p, aux_p = fmod.get_params()
+        sym, qargs, qaux = quantize_model(sym, arg_p, aux_p,
+                                          compute_dtype=serve_dtype)
+        del fmod
     mod = mx.mod.Module(symbol=sym, context=mx.tpu())
     # TPU-native serving tier: binding with a bf16 DataDesc makes type
     # inference allocate the EXECUTOR arrays (params included) in bf16,
@@ -42,16 +60,29 @@ def score(network, batch_size, image_shape=(3, 224, 224), num_batches=None,
     # variants (symbols/alexnet_fp16.py, resnet_fp16.py).
     mod.bind(for_training=False, inputs_need_grad=False,
              data_shapes=[mx.io.DataDesc("data", data_shape,
-                                         np.dtype(dtype))])
-    mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
-    bound = str(mod._exec_group.execs[0].arg_dict["data"].dtype)
-    if bound != dtype:           # survives python -O, unlike assert
-        raise RuntimeError("requested %s but executor bound %s — the "
-                           "dtype was silently undone" % (dtype, bound))
+                                         np.dtype(serve_dtype))])
+    if quant:
+        mod.set_params(qargs, qaux)
+        arg_dict = mod._exec_group.execs[0].arg_dict
+        wq = next(n for n in arg_dict if n.endswith("_quant"))
+        bound = str(arg_dict[wq].dtype)
+        if bound != "int8":
+            raise RuntimeError("quantized weight bound as %s" % bound)
+        bound = str(arg_dict["data"].dtype)
+        if bound != serve_dtype:
+            raise RuntimeError("int8 tier serves %s but data bound %s"
+                               % (serve_dtype, bound))
+    else:
+        mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+        bound = str(mod._exec_group.execs[0].arg_dict["data"].dtype)
+        if bound != dtype:       # survives python -O, unlike assert
+            raise RuntimeError("requested %s but executor bound %s — "
+                               "the dtype was silently undone"
+                               % (dtype, bound))
     rng = np.random.RandomState(0)
     batch = mx.io.DataBatch(
         data=[mx.nd.array(rng.uniform(-1, 1, data_shape))
-              .astype(dtype)], label=[])
+              .astype(serve_dtype)], label=[])
 
     def sync():
         # scalar fetch = completion barrier (block_until_ready is a
@@ -86,9 +117,11 @@ def main(argv=None):
                                 "resnet-50,resnet-152")
     parser.add_argument("--batch-sizes", type=str, default="1,32")
     parser.add_argument("--dtypes", type=str, default="float32",
-                        help="comma list; bfloat16 adds the TPU-native "
-                             "serving tier (params + input cast, halved "
-                             "weight traffic)")
+                        help="comma list; bfloat16 = TPU-native serving "
+                             "tier (executor bound in bf16, halved "
+                             "weight traffic); int8 = weights-only "
+                             "quantized storage + bf16 compute "
+                             "(mx.contrib.quantization)")
     parser.add_argument("--num-batches", type=int, default=None,
                         help="override the timed window (CI uses a small "
                              "bounded one; default scales with batch)")

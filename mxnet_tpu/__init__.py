@@ -132,3 +132,6 @@ for _n in list(vars(symbol)):
 for _n in list(vars(ndarray)):
     if _n.startswith("_contrib_"):
         setattr(ndarray.contrib, _n[len("_contrib_"):], getattr(ndarray, _n))
+
+# python-level contrib modules (mx.contrib.quantization, ...)
+from . import contrib  # noqa: E402,F401

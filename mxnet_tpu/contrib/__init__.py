@@ -1,0 +1,4 @@
+"""Python-level contrib namespace (reference grew ``mx.contrib.*``
+modules alongside the flat ``_contrib_*`` ops; the op namespaces live
+on ``mx.sym.contrib`` / ``mx.nd.contrib``)."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,153 @@
+"""Weights-only int8 quantization for serving.
+
+The 2017 reference predates quantized inference (classic MXNet grew
+``mx.contrib.quantization`` later; the API here mirrors that entry
+point's shape).  The TPU-native design goal is HBM traffic, not int8
+matmuls: weights are STORED int8 with per-output-channel float scales
+and dequantized INSIDE the compiled program (one fused
+``cast * scale`` that XLA folds into the consumer's epilogue), so
+weight reads cost 1 byte/elem — half of bf16, a quarter of f32 — while
+the MXU still computes in the serving dtype.  That targets exactly the
+nets whose serving is weight-bound (AlexNet/VGG-style FC layers,
+embedding-heavy rankers).
+
+``quantize_model(sym, arg_params)`` returns a rewritten symbol whose
+quantized weight variables carry ``__dtype__`` attrs (so binding
+allocates true int8 HBM storage — a post-bind cast would be silently
+undone by copyto) plus the matching quantized parameter dict.  Accuracy
+contract: per-channel symmetric rounding keeps max weight error at
+``max|W_c| / 254``; the op-level test asserts end-to-end logits within
+~1% and unchanged argmax on a trained net.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["quantize_params", "quantize_model"]
+
+_DEFAULT_OPS = ("FullyConnected", "Convolution", "Deconvolution")
+
+
+def _quantize_weight(w, dtype="int8"):
+    """Per-output-channel (axis 0) symmetric quantization.
+
+    Returns (wq int8 ndarray, scale float32 broadcastable to w)."""
+    if dtype != "int8":
+        raise MXNetError("only int8 weight quantization is supported")
+    arr = w.asnumpy() if hasattr(w, "asnumpy") else np.asarray(w)
+    flat = np.abs(arr.reshape(arr.shape[0], -1)).max(axis=1)
+    scale = (flat / 127.0).astype(np.float32)
+    scale = np.where(scale == 0.0, 1.0, scale)
+    scale_b = scale.reshape((-1,) + (1,) * (arr.ndim - 1))
+    wq = np.clip(np.rint(arr / scale_b), -127, 127).astype(np.int8)
+    return wq, scale_b
+
+
+def quantize_params(arg_params, weight_names, quantized_dtype="int8"):
+    """Quantize the named weights; other params pass through unchanged."""
+    from .. import ndarray as nd
+    out = {}
+    for name, arr in arg_params.items():
+        if name in weight_names:
+            wq, scale = _quantize_weight(arr, quantized_dtype)
+            out[name + "_quant"] = nd.array(wq, dtype=np.int8)
+            out[name + "_quant_scale"] = nd.array(scale)
+        else:
+            out[name] = arr
+    return out
+
+
+def quantize_model(sym, arg_params, aux_params=None,
+                   quantized_dtype="int8", compute_dtype="float32",
+                   quantize_op_names=_DEFAULT_OPS,
+                   excluded_sym_names=(), min_elems=1024):
+    """Rewrite ``sym`` for weights-only int8 serving.
+
+    Every ``quantize_op_names`` node's weight variable (unless the node
+    is in ``excluded_sym_names`` or the weight has fewer than
+    ``min_elems`` elements — tiny weights don't pay for their scale
+    metadata) is replaced by
+    ``broadcast_mul(Cast(W_quant, compute_dtype), W_quant_scale)``;
+    binding then stores the weight as int8 in HBM and XLA fuses the
+    dequantize into the consumer.  ``compute_dtype`` must match the
+    dtype the caller serves in (``"bfloat16"`` for the bf16 tier).
+
+    Returns ``(qsym, qarg_params, aux_params)`` — same contract shape
+    as classic MXNet's ``mx.contrib.quantization.quantize_model``.
+    """
+    from .. import symbol as _sym
+    from ..symbol import Symbol, _Node, _topo
+
+    heads = [e[0] for e in sym._outputs]
+    nodes = _topo(heads)
+
+    # weight variables feeding a quantizable op, by variable node id
+    excluded = set(excluded_sym_names)
+    to_quant = {}
+    for n in nodes:
+        if n.is_variable or n.op.name not in quantize_op_names \
+                or n.name in excluded:
+            continue
+        in_names = n.op.list_inputs(n.params)
+        for slot, iname in enumerate(in_names):
+            if iname != "weight" or slot >= len(n.inputs):
+                continue
+            var = n.inputs[slot][0]
+            if not var.is_variable:
+                continue                      # shared/computed weight
+            w = arg_params.get(var.name)
+            if w is None or int(np.prod(w.shape)) < min_elems:
+                continue
+            to_quant[id(var)] = var.name
+
+    if not to_quant:
+        raise MXNetError(
+            "nothing to quantize: no %s weight >= %d elems found"
+            % ("/".join(quantize_op_names), min_elems))
+
+    # rebuild the graph with dequantize subgraphs spliced in (clone all
+    # nodes: the caller's symbol must stay untouched)
+    memo = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.is_variable:
+            if id(node) in to_quant:
+                name = node.name
+                # explicit shapes: shape inference cannot invert through
+                # the dequant subgraph (the consumer knows its WEIGHT
+                # shape, not the shapes of an op's inputs), and they are
+                # known here from the float params anyway
+                wshape = tuple(arg_params[name].shape)
+                sshape = (wshape[0],) + (1,) * (len(wshape) - 1)
+                deq = _sym.broadcast_mul(
+                    _sym.Cast(
+                        _sym.Variable(name + "_quant", shape=wshape,
+                                      dtype=quantized_dtype),
+                        dtype=compute_dtype),
+                    _sym.Variable(name + "_quant_scale", shape=sshape,
+                                  dtype=compute_dtype),
+                    name=name + "_dequant")
+                new = deq._outputs[0][0]
+            else:
+                new = _Node(None, node.name, attrs=dict(node.attrs))
+        else:
+            new = _Node(node.op, node.name, params=dict(node.params),
+                        attrs=dict(node.attrs),
+                        inputs=[(rebuild(c), i) for c, i in node.inputs])
+        memo[id(node)] = new
+        return new
+
+    qsym = Symbol([(rebuild(n), i) for n, i in sym._outputs])
+    qargs = quantize_params(arg_params, set(to_quant.values()),
+                            quantized_dtype)
+    if compute_dtype != "float32":
+        # scales ride the compute dtype so broadcast_mul type-infers
+        # cleanly; bf16's 8 mantissa bits match the int8 payload
+        for k in list(qargs):
+            if k.endswith("_quant_scale"):
+                qargs[k] = qargs[k].astype(compute_dtype)
+    return qsym, qargs, dict(aux_params or {})

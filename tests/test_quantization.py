@@ -1,0 +1,99 @@
+"""Weights-only int8 serving (mx.contrib.quantization): the rewritten
+graph must bind its quantized weights as TRUE int8 storage, reproduce
+the float model's predictions, and leave training-only machinery
+untouched (the transform is inference-side)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import quantization as q
+
+
+def _trained_convnet():
+    rng = np.random.RandomState(0)
+    protos = rng.normal(0, 1, (4, 1, 8, 8))
+    y = rng.randint(0, 4, 512)
+    x = (protos[y] + rng.normal(0, 0.4, (512, 1, 8, 8))).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(x, y.astype("f"), 64, shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier())
+    arg_p, aux_p = mod.get_params()
+    return net, arg_p, aux_p, x, y
+
+
+def _score(sym, arg_p, aux_p, x):
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=[mx.io.DataDesc("data", (64, 1, 8, 8))],
+             for_training=False)
+    mod.set_params(arg_p, aux_p)
+    outs = []
+    for s in range(0, len(x), 64):
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(x[s:s + 64])], label=[]), is_train=False)
+        outs.append(mod.get_outputs()[0].asnumpy())
+    return np.concatenate(outs), mod
+
+
+def test_quantize_model_end_to_end():
+    net, arg_p, aux_p, x, y = _trained_convnet()
+    ref_probs, _ = _score(net, arg_p, aux_p, x)
+
+    qsym, qargs, qaux = q.quantize_model(net, arg_p, aux_p,
+                                         min_elems=100)
+    # conv1 (72 elems) excluded by min_elems=100; fc1/fc2 quantized
+    names = set(qargs)
+    assert "fc1_weight_quant" in names and "fc2_weight_quant" in names
+    assert "conv1_weight" in names and "fc1_weight" not in names
+    assert qargs["fc1_weight_quant"].dtype == np.int8
+    # original symbol untouched
+    assert "fc1_weight" in net.list_arguments()
+
+    q_probs, qmod = _score(qsym, qargs, qaux, x)
+    # executor stores the weight as REAL int8 (not silently upcast)
+    exe = qmod._exec_group.execs[0]
+    assert exe.arg_dict["fc1_weight_quant"].dtype == np.int8
+    # per-channel int8 keeps serving predictions essentially intact
+    assert (q_probs.argmax(1) == ref_probs.argmax(1)).mean() > 0.995
+    np.testing.assert_allclose(q_probs, ref_probs, atol=0.02)
+
+
+def test_quantize_weight_roundtrip():
+    rng = np.random.RandomState(1)
+    w = rng.normal(0, 0.3, (16, 40)).astype("f")
+    wq, scale = q._quantize_weight(w)
+    assert wq.dtype == np.int8 and scale.shape == (16, 1)
+    err = np.abs(wq.astype("f") * scale - w)
+    assert err.max() <= np.abs(w).max() / 127.0 + 1e-7
+
+
+def test_quantize_model_rejects_empty():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    with pytest.raises(mx.base.MXNetError):
+        q.quantize_model(net, {"fc_weight": mx.nd.zeros((2, 4))},
+                         min_elems=64)
+
+
+def test_quantize_model_save_load_roundtrip(tmp_path):
+    """The rewritten symbol serializes and reloads (deploy contract)."""
+    net, arg_p, aux_p, x, _ = _trained_convnet()
+    qsym, qargs, qaux = q.quantize_model(net, arg_p, aux_p, min_elems=64)
+    p = str(tmp_path / "qnet.json")
+    qsym.save(p)
+    back = mx.sym.load(p)
+    assert back.list_arguments() == qsym.list_arguments()
+    ref, _ = _score(qsym, qargs, qaux, x[:64])
+    got, _ = _score(back, qargs, qaux, x[:64])
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
