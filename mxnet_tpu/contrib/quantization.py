@@ -30,28 +30,44 @@ __all__ = ["quantize_params", "quantize_model"]
 _DEFAULT_OPS = ("FullyConnected", "Convolution", "Deconvolution")
 
 
-def _quantize_weight(w, dtype="int8"):
-    """Per-output-channel (axis 0) symmetric quantization.
+# which weight axis indexes OUTPUT channels, per op (FC/Conv store
+# weights (Cout, ...); Deconvolution stores (Cin, Cout/g, *k) —
+# mxnet_tpu/op/nn.py — so its per-output-channel axis is 1)
+_CHANNEL_AXIS = {"FullyConnected": 0, "Convolution": 0,
+                 "Deconvolution": 1}
+
+
+def _quantize_weight(w, dtype="int8", axis=0):
+    """Per-output-channel symmetric quantization along ``axis``.
 
     Returns (wq int8 ndarray, scale float32 broadcastable to w)."""
     if dtype != "int8":
         raise MXNetError("only int8 weight quantization is supported")
     arr = w.asnumpy() if hasattr(w, "asnumpy") else np.asarray(w)
-    flat = np.abs(arr.reshape(arr.shape[0], -1)).max(axis=1)
+    reduce_axes = tuple(a for a in range(arr.ndim) if a != axis)
+    flat = np.abs(arr).max(axis=reduce_axes)
     scale = (flat / 127.0).astype(np.float32)
     scale = np.where(scale == 0.0, 1.0, scale)
-    scale_b = scale.reshape((-1,) + (1,) * (arr.ndim - 1))
+    sshape = [1] * arr.ndim
+    sshape[axis] = arr.shape[axis]
+    scale_b = scale.reshape(sshape)
     wq = np.clip(np.rint(arr / scale_b), -127, 127).astype(np.int8)
     return wq, scale_b
 
 
 def quantize_params(arg_params, weight_names, quantized_dtype="int8"):
-    """Quantize the named weights; other params pass through unchanged."""
+    """Quantize the named weights; other params pass through unchanged.
+
+    ``weight_names``: mapping name -> output-channel axis (a set is
+    accepted too, meaning axis 0 for every name)."""
     from .. import ndarray as nd
+    if not isinstance(weight_names, dict):
+        weight_names = {n: 0 for n in weight_names}
     out = {}
     for name, arr in arg_params.items():
         if name in weight_names:
-            wq, scale = _quantize_weight(arr, quantized_dtype)
+            wq, scale = _quantize_weight(arr, quantized_dtype,
+                                         axis=weight_names[name])
             out[name + "_quant"] = nd.array(wq, dtype=np.int8)
             out[name + "_quant_scale"] = nd.array(scale)
         else:
@@ -83,24 +99,37 @@ def quantize_model(sym, arg_params, aux_params=None,
     heads = [e[0] for e in sym._outputs]
     nodes = _topo(heads)
 
-    # weight variables feeding a quantizable op, by variable node id
+    # Candidate selection is per VARIABLE, but eligibility is decided
+    # over ALL of a variable's consumers: quantizing rewrites the
+    # variable everywhere, so a weight shared with an excluded node
+    # (the "protect the stem" knob) or with any non-quantizable
+    # consumer (tied embedding/output-projection weights) must stay
+    # float — otherwise the exclusion would be silently bypassed.
     excluded = set(excluded_sym_names)
-    to_quant = {}
+    uses = {}                       # var id -> list of (node, slot_name)
     for n in nodes:
-        if n.is_variable or n.op.name not in quantize_op_names \
-                or n.name in excluded:
+        if n.is_variable:
             continue
         in_names = n.op.list_inputs(n.params)
-        for slot, iname in enumerate(in_names):
-            if iname != "weight" or slot >= len(n.inputs):
-                continue
-            var = n.inputs[slot][0]
-            if not var.is_variable:
-                continue                      # shared/computed weight
-            w = arg_params.get(var.name)
-            if w is None or int(np.prod(w.shape)) < min_elems:
-                continue
-            to_quant[id(var)] = var.name
+        for slot, (child, _) in enumerate(n.inputs):
+            if child.is_variable:
+                iname = in_names[slot] if slot < len(in_names) else "?"
+                uses.setdefault(id(child), []).append((n, iname, child))
+
+    to_quant = {}                   # var id -> (name, channel axis)
+    for var_id, consumers in uses.items():
+        var = consumers[0][2]
+        if not all(node.op.name in quantize_op_names
+                   and iname == "weight" and node.name not in excluded
+                   for node, iname, _ in consumers):
+            continue
+        w = arg_params.get(var.name)
+        if w is None or int(np.prod(w.shape)) < min_elems:
+            continue
+        axes = {_CHANNEL_AXIS[node.op.name] for node, _, _ in consumers}
+        if len(axes) != 1:
+            continue      # shared across layouts with different channel
+        to_quant[var_id] = (var.name, axes.pop())
 
     if not to_quant:
         raise MXNetError(
@@ -116,13 +145,15 @@ def quantize_model(sym, arg_params, aux_params=None,
             return memo[id(node)]
         if node.is_variable:
             if id(node) in to_quant:
-                name = node.name
+                name, ch_axis = to_quant[id(node)]
                 # explicit shapes: shape inference cannot invert through
                 # the dequant subgraph (the consumer knows its WEIGHT
                 # shape, not the shapes of an op's inputs), and they are
                 # known here from the float params anyway
                 wshape = tuple(arg_params[name].shape)
-                sshape = (wshape[0],) + (1,) * (len(wshape) - 1)
+                sshape = [1] * len(wshape)
+                sshape[ch_axis] = wshape[ch_axis]
+                sshape = tuple(sshape)
                 deq = _sym.broadcast_mul(
                     _sym.Cast(
                         _sym.Variable(name + "_quant", shape=wshape,
@@ -142,7 +173,7 @@ def quantize_model(sym, arg_params, aux_params=None,
         return new
 
     qsym = Symbol([(rebuild(n), i) for n, i in sym._outputs])
-    qargs = quantize_params(arg_params, set(to_quant.values()),
+    qargs = quantize_params(arg_params, dict(to_quant.values()),
                             quantized_dtype)
     if compute_dtype != "float32":
         # scales ride the compute dtype so broadcast_mul type-infers

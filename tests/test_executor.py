@@ -208,3 +208,30 @@ def test_eval_forward_skips_key_derivation(monkeypatch):
     b = sex.forward(is_train=False)[0].asnumpy().copy()
     assert calls["n"] == 2, "sampler eval forwards must draw fresh keys"
     assert not np.allclose(a, b), "sampler eval draws must differ"
+
+
+def test_load_general_adopts_whole_batch_buffer():
+    """Whole-batch same-dtype same-device loads must adopt the source
+    buffer (zero dispatched ops) instead of slicing — the other half of
+    the round-4 dispatch fix (executor_manager._load_general)."""
+    from mxnet_tpu import io
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                              name="fc"), name="softmax")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))], for_training=False)
+    mod.init_params(mx.init.Uniform(0.1))
+    src = mx.nd.array(np.random.RandomState(0).rand(4, 8).astype("f"))
+    mod.forward(io.DataBatch(data=[src],
+                             label=[mx.nd.zeros((4,))]), is_train=False)
+    bound = mod._exec_group.execs[0].arg_dict["data"]
+    assert bound.data is src.data, \
+        "fast path must alias the caller's buffer, not copy"
+    # mismatched dtype still goes through the casting copy path
+    src16 = src.astype("float16")
+    mod.forward(io.DataBatch(data=[src16],
+                             label=[mx.nd.zeros((4,))]), is_train=False)
+    bound = mod._exec_group.execs[0].arg_dict["data"]
+    assert bound.data is not src16.data
+    assert str(bound.dtype) == "float32"

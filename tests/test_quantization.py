@@ -77,6 +77,75 @@ def test_quantize_weight_roundtrip():
     assert err.max() <= np.abs(w).max() / 127.0 + 1e-7
 
 
+def test_excluded_consumer_protects_shared_weight():
+    """A weight shared between an excluded and a non-excluded consumer
+    must stay float: quantization rewrites the VARIABLE, so exclusion
+    of any consumer has to veto it (the 'protect the stem' knob on
+    tied-weight models)."""
+    data = mx.sym.Variable("data")
+    w = mx.sym.Variable("shared_weight")
+    a = mx.sym.FullyConnected(data, weight=w, num_hidden=8, no_bias=True,
+                              name="fca")
+    bsym = mx.sym.FullyConnected(data, weight=w, num_hidden=8,
+                                 no_bias=True, name="fcb")
+    net = mx.sym.SoftmaxOutput(a + bsym, name="softmax")
+    params = {"shared_weight": mx.nd.array(
+        np.random.RandomState(0).rand(8, 32).astype("f"))}
+    with pytest.raises(mx.base.MXNetError):
+        # the only candidate is vetoed -> nothing to quantize
+        q.quantize_model(net, params, min_elems=1,
+                         excluded_sym_names=("fca",))
+    # without the exclusion the shared weight quantizes once
+    qsym, qargs, _ = q.quantize_model(net, params, min_elems=1)
+    assert "shared_weight_quant" in qargs
+    # tied to a NON-quantizable consumer: stays float
+    tied = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, weight=w, num_hidden=8,
+                              no_bias=True, name="fcc")
+        + mx.sym.sum(w), name="softmax")
+    with pytest.raises(mx.base.MXNetError):
+        q.quantize_model(tied, params, min_elems=1)
+
+
+def test_deconvolution_channel_axis():
+    """Deconvolution weights are (Cin, Cout/g, *k): scales must ride
+    axis 1, giving one scale per OUTPUT channel as documented."""
+    rng = np.random.RandomState(0)
+    data = mx.sym.Variable("data")
+    net = mx.sym.Deconvolution(data, kernel=(2, 2), stride=(2, 2),
+                               num_filter=6, no_bias=True, name="up")
+    net = mx.sym.LinearRegressionOutput(mx.sym.Flatten(net),
+                                        name="softmax")
+    w = (rng.rand(3, 6, 2, 2) * np.arange(1, 7)[None, :, None, None]) \
+        .astype("f")
+    params = {"up_weight": mx.nd.array(w)}
+    qsym, qargs, _ = q.quantize_model(net, params, min_elems=1)
+    scale = qargs["up_weight_quant_scale"].asnumpy()
+    assert scale.shape == (1, 6, 1, 1)
+    # per-output-channel max/127 exactly
+    np.testing.assert_allclose(
+        scale.reshape(6), np.abs(w).max(axis=(0, 2, 3)) / 127.0,
+        rtol=1e-6)
+    # and the quantized deconv still reproduces the float output
+    x = rng.rand(2, 3, 4, 4).astype("f")
+
+    def fwd(sym, args):
+        mod = mx.mod.Module(sym, context=mx.cpu())
+        mod.bind(data_shapes=[mx.io.DataDesc("data", (2, 3, 4, 4))],
+                 label_shapes=[("softmax_label", (2, 96))],
+                 for_training=False)
+        mod.set_params(args, {})
+        mod.forward(mx.io.DataBatch(
+            data=[mx.nd.array(x)],
+            label=[mx.nd.zeros((2, 96))]), is_train=False)
+        return mod.get_outputs()[0].asnumpy()
+
+    # error bound: 12 accumulated taps x per-weight error (max|W_c|/254,
+    # here up to ~0.024) -> a few tenths worst-case on outputs up to ~20
+    np.testing.assert_allclose(fwd(qsym, qargs),
+                               fwd(net, params), rtol=0.02, atol=0.15)
+
+
 def test_quantize_model_rejects_empty():
     data = mx.sym.Variable("data")
     net = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
