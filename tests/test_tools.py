@@ -12,9 +12,9 @@ import mxnet_tpu as mx
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, **kw):
+def _run(args, timeout=240, **kw):
     return subprocess.run([sys.executable] + args, capture_output=True,
-                          text=True, cwd=_ROOT, timeout=240, **kw)
+                          text=True, cwd=_ROOT, timeout=timeout, **kw)
 
 
 def test_im2rec_roundtrip(tmp_path):
@@ -87,3 +87,20 @@ def test_gen_op_docs(tmp_path):
     text = open(path).read()
     assert "## FullyConnected" in text
     assert "**required**" in text
+
+
+def test_attn_bench_smoke(tmp_path):
+    """tools/attn_bench.py runs end-to-end at toy size (flash in
+    interpret mode on CPU) and writes a well-formed artifact."""
+    import json
+    out = str(tmp_path / "attn.json")
+    res = _run([os.path.join(_ROOT, "tools", "attn_bench.py"),
+                "--seqs", "128", "--batch", "1", "--heads", "2",
+                "--dim", "64", "--steps", "2", "--out", out],
+               timeout=280, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert res.returncode == 0, res.stderr[-2000:]
+    art = json.load(open(out))
+    row = art["rows"][0]
+    assert row["seq"] == 128
+    assert "flash_fwd_ms" in row and "naive_fwd_ms" in row
+    assert "flash_fwdbwd_ms" in row
