@@ -51,6 +51,11 @@ python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_mlp.py
 python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_fused_mlp.py
+if [ "${MXTPU_CI_FULL:-0}" = "1" ]; then
+    # nightly: the sum semantics must hold beyond the 2-worker case
+    python tools/launch.py -n 3 --launcher local -- \
+        python tests/nightly/dist_sync_kvstore.py
+fi
 
 stage "crash-restart recovery (auto-restart orchestration)"
 # heartbeats over the jax.distributed coordination service (no shared
