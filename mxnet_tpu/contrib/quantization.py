@@ -140,39 +140,40 @@ def quantize_model(sym, arg_params, aux_params=None,
     # nodes: the caller's symbol must stay untouched)
     memo = {}
 
-    def rebuild(node):
-        if id(node) in memo:
-            return memo[id(node)]
-        if node.is_variable:
-            if id(node) in to_quant:
-                name, ch_axis = to_quant[id(node)]
-                # explicit shapes: shape inference cannot invert through
-                # the dequant subgraph (the consumer knows its WEIGHT
-                # shape, not the shapes of an op's inputs), and they are
-                # known here from the float params anyway
-                wshape = tuple(arg_params[name].shape)
-                sshape = [1] * len(wshape)
-                sshape[ch_axis] = wshape[ch_axis]
-                sshape = tuple(sshape)
-                deq = _sym.broadcast_mul(
-                    _sym.Cast(
-                        _sym.Variable(name + "_quant", shape=wshape,
-                                      dtype=quantized_dtype),
-                        dtype=compute_dtype),
-                    _sym.Variable(name + "_quant_scale", shape=sshape,
-                                  dtype=compute_dtype),
-                    name=name + "_dequant")
-                new = deq._outputs[0][0]
-            else:
-                new = _Node(None, node.name, attrs=dict(node.attrs))
-        else:
-            new = _Node(node.op, node.name, params=dict(node.params),
-                        attrs=dict(node.attrs),
-                        inputs=[(rebuild(c), i) for c, i in node.inputs])
-        memo[id(node)] = new
-        return new
+    def rebuild_var(node):
+        if id(node) in to_quant:
+            name, ch_axis = to_quant[id(node)]
+            # explicit shapes: shape inference cannot invert through
+            # the dequant subgraph (the consumer knows its WEIGHT
+            # shape, not the shapes of an op's inputs), and they are
+            # known here from the float params anyway
+            wshape = tuple(arg_params[name].shape)
+            sshape = [1] * len(wshape)
+            sshape[ch_axis] = wshape[ch_axis]
+            sshape = tuple(sshape)
+            deq = _sym.broadcast_mul(
+                _sym.Cast(
+                    _sym.Variable(name + "_quant", shape=wshape,
+                                  dtype=quantized_dtype),
+                    dtype=compute_dtype),
+                _sym.Variable(name + "_quant_scale", shape=sshape,
+                              dtype=compute_dtype),
+                name=name + "_dequant")
+            return deq._outputs[0][0]
+        return _Node(None, node.name, attrs=dict(node.attrs))
 
-    qsym = Symbol([(rebuild(n), i) for n, i in sym._outputs])
+    # splice bottom-up over the topo order (iterative — graph depth is
+    # not bounded by the Python recursion limit)
+    for node in nodes:
+        if node.is_variable:
+            memo[id(node)] = rebuild_var(node)
+        else:
+            memo[id(node)] = _Node(
+                node.op, node.name, params=dict(node.params),
+                attrs=dict(node.attrs),
+                inputs=[(memo[id(c)], i) for c, i in node.inputs])
+
+    qsym = Symbol([(memo[id(n)], i) for n, i in sym._outputs])
     qargs = quantize_params(arg_params, dict(to_quant.values()),
                             quantized_dtype)
     if compute_dtype != "float32":

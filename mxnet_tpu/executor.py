@@ -225,7 +225,7 @@ class Executor:
             # const key: placement is one-time too (see _next_key)
             try:
                 dev = list(arg_vals[0].devices())[0]
-                if self._const_key_dev is not dev:
+                if self._const_key_dev != dev:
                     self._const_key = jax.device_put(key, dev)
                     self._const_key_dev = dev
                 key = self._const_key

@@ -60,10 +60,12 @@ def _load_general(data, targets):
             d_src.copyto(d_targets)
         elif isinstance(d_src, nd.NDArray):
             # slice on-device (XLA slice): no host round trip per batch
+            n_src = int(d_src.shape[0]) if d_src.shape else 0
             for slice_idx, d_dst in d_targets:
                 if (d_src.dtype == d_dst.dtype
                         and tuple(d_src.shape) == tuple(d_dst.shape)
-                        and d_src.context == d_dst.context):
+                        and d_src.context == d_dst.context
+                        and slice_idx.indices(n_src) == (0, n_src, 1)):
                     # single-executor fast path: whole batch, same dtype
                     # and device — adopt the buffer, zero dispatched ops
                     # (on a tunneled chip every dispatch is latency)

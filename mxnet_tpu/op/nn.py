@@ -362,7 +362,7 @@ def _layer_norm(p, c, data, gamma, beta):
                        Param("lower_bound", float, 0.125),
                        Param("upper_bound", float, 0.334)),
           input_names=lambda p: ["data", "gamma"] if p.get("act_type") == "prelu" else ["data"],
-          uses_rng=True, hint="leakyrelu")
+          uses_rng=True, rng_in_eval=False, hint="leakyrelu")
 def _leaky_relu(p, c, data, gamma=None):
     t = p["act_type"]
     if t == "leaky":
@@ -416,7 +416,7 @@ def _log_softmax(p, c, a):
 # ----------------------------------------------------------------------
 # Dropout
 @register("Dropout", params_spec=(Param("p", float, 0.5),),
-          uses_rng=True, hint="dropout")
+          uses_rng=True, rng_in_eval=False, hint="dropout")
 def _dropout(p, c, a):
     if not c.is_train or p["p"] <= 0.0:
         return a

@@ -140,15 +140,22 @@ class Op:
     infer_shape: Optional[Callable] = None  # (params, in_shapes) -> (in,out,aux)
     infer_dtype: Optional[Callable] = None
     uses_rng: bool = False
-    # rng consumed even at is_train=False (samplers).  Train-only noise
-    # ops (Dropout, rrelu, RNN dropout) leave this False so an
-    # inference executor never pays per-forward key derivation — on a
-    # tunneled chip each eager key op is a round trip
-    rng_in_eval: bool = False
+    # rng consumed even at is_train=False.  Defaults to uses_rng so an
+    # unclassified rng op (e.g. a third-party sampler registered via
+    # extension-ops) stays correct — fresh keys every forward.  The
+    # audited train-only noise ops (Dropout, rrelu, RNN dropout)
+    # explicitly opt OUT so an inference executor never pays per-forward
+    # key derivation — on a tunneled chip each eager key op is a round
+    # trip.  ``None`` means "inherit uses_rng".
+    rng_in_eval: Optional[bool] = None
     mode_dependent: bool = False  # retrace per is_train value
     hint: str = ""  # auto-naming hint, defaults to lowercased name
     # ops whose outputs must not be differentiated through label-style inputs
     # handle that themselves via jax.custom_vjp / stop_gradient in `fn`.
+
+    def __post_init__(self):
+        if self.rng_in_eval is None:
+            self.rng_in_eval = self.uses_rng
 
     def list_inputs(self, params) -> List[str]:
         names = self.input_names(params) if callable(self.input_names) else self.input_names

@@ -125,7 +125,8 @@ def _cell_scan(mode, x_proj, wh, bh, h0, c0, reverse, clip=None):
                                    if p.get("mode") == "lstm"
                                    else ["output", "state"])
                                   if p.get("state_outputs") else ["output"]),
-          uses_rng=True, mode_dependent=True, hint="rnn")
+          uses_rng=True, rng_in_eval=False, mode_dependent=True,
+          hint="rnn")
 def _rnn(p, c, data, parameters, state, state_cell=None):
     """data (T, N, input_size) TNC; state (L*D, N, H)."""
     mode = p["mode"]

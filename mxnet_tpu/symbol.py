@@ -51,19 +51,22 @@ class _Node:
 
 
 def _topo(nodes_out: Sequence[_Node]) -> List[_Node]:
-    seen = {}
+    # iterative post-order: graph depth must not be bounded by the
+    # Python recursion limit (a 1000+-layer sequential net is legal)
+    seen = set()
     order = []
-
-    def visit(node):
+    stack = [(n, False) for n in reversed(nodes_out)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            order.append(node)
+            continue
         if id(node) in seen:
-            return
-        seen[id(node)] = True
-        for child, _ in node.inputs:
-            visit(child)
-        order.append(node)
-
-    for n in nodes_out:
-        visit(n)
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for child, _ in reversed(node.inputs):
+            stack.append((child, False))
     return order
 
 
