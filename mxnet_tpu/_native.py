@@ -112,6 +112,10 @@ def _dl_declare(lib):
     lib.mxt_loader_next.argtypes = [c.c_void_p,
                                     c.POINTER(c.c_float),
                                     c.POINTER(c.c_float)]
+    lib.mxt_loader_next_u8.restype = c.c_int
+    lib.mxt_loader_next_u8.argtypes = [c.c_void_p,
+                                       c.POINTER(c.c_uint8),
+                                       c.POINTER(c.c_float)]
     lib.mxt_loader_free.argtypes = [c.c_void_p]
     lib.mxt_loader_set_layout.argtypes = [c.c_void_p, c.c_int]
     return lib

@@ -308,6 +308,178 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+class DeviceUploadIter(DataIter):
+    """Stages each batch on the accelerator AHEAD of consumption.
+
+    ``PrefetchingIter`` overlaps host decode with device compute; this is
+    the other half of the reference prefetcher contract
+    (``src/io/iter_prefetcher.h:28-129``: the next batch is staged through
+    pinned memory while the current one computes): a background thread
+    pulls host batches from ``it`` and runs their ``jax.device_put`` —
+    so the H2D crossing of batch N+1 rides under the compute (and, on a
+    tunneled chip, the dispatch latency) of batch N.  The consumer
+    receives batches whose arrays are already device-resident; the fused
+    trainer then pays ZERO upload wait inside ``step()``.
+
+    ``depth`` bounds device-side staging memory (depth x batch bytes).
+    ``stats()`` reports where the worker's wall went — ``upload_s`` vs
+    ``source_s`` (inner-iterator wait) — so a pipeline benchmark can
+    attribute per-batch time to named stages.
+    """
+
+    _END = object()
+
+    def __init__(self, it, device=None, depth=2,
+                 data_shardings=None, label_shardings=None):
+        super().__init__()
+        self.it = it
+        self.batch_size = getattr(it, "batch_size", 0)
+        self._device = device
+        self._data_shardings = data_shardings
+        self._label_shardings = label_shardings
+        self._depth = max(1, int(depth))
+        self._q = queue.Queue(self._depth)
+        self._stop = threading.Event()
+        self._err = None
+        self.upload_s = 0.0
+        self.source_s = 0.0
+        self.batches_staged = 0
+        self._worker = None
+        self._ended = False
+        # the worker starts LAZILY on the first next(): a reset (or
+        # construction) must not advance the wrapped iterator before the
+        # consumer actually asks for data — fit() resets after its final
+        # epoch and the caller's iterator must stay at a fresh start
+
+    @property
+    def provide_data(self):
+        return self.it.provide_data
+
+    @property
+    def provide_label(self):
+        return self.it.provide_label
+
+    # ------------------------------------------------------------------
+    def _start_worker(self):
+        self._stop.clear()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def _run(self):
+        import time as _time
+        import jax
+        try:
+            while not self._stop.is_set():
+                t0 = _time.perf_counter()
+                try:
+                    b = self.it.next()
+                except StopIteration:
+                    self._put(self._END)
+                    return
+                self.source_s += _time.perf_counter() - t0
+                t0 = _time.perf_counter()
+                data = [self._upload(a, self._data_shardings, i)
+                        for i, a in enumerate(b.data)]
+                label = [self._upload(a, self._label_shardings, i)
+                         for i, a in enumerate(b.label or [])]
+                jax.block_until_ready([a.data for a in data + label])
+                self.upload_s += _time.perf_counter() - t0
+                self.batches_staged += 1
+                staged = DataBatch(data=data, label=label, pad=b.pad,
+                                   index=b.index,
+                                   provide_data=b.provide_data,
+                                   provide_label=b.provide_label)
+                if not self._put(staged):
+                    return
+        except Exception as e:              # surface in the consumer
+            self._err = e
+            self._put(self._END)
+
+    def _upload(self, a, shardings, i):
+        import jax
+        if isinstance(a, NDArray):
+            return a                       # already device-resident
+        placement = shardings[i] if shardings else self._device
+        return NDArray(jax.device_put(np.asarray(a), placement))
+
+    def _put(self, item):
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                pass
+        return False
+
+    def _shutdown_worker(self):
+        self._stop.set()
+        while self._worker is not None and self._worker.is_alive():
+            try:                            # unblock a full-queue put
+                self._q.get_nowait()
+            except queue.Empty:
+                pass
+            self._worker.join(timeout=0.05)
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+
+    def __del__(self):
+        try:
+            self._shutdown_worker()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    def reset(self):
+        self._shutdown_worker()
+        self.it.reset()
+        self._ended = False
+
+    def next(self):
+        if self._ended:                 # exhausted: repeatable, no hang
+            raise StopIteration
+        if self._worker is None or not (self._worker.is_alive()
+                                        or self._q.qsize()):
+            self._start_worker()
+        item = self._q.get()
+        if item is self._END:
+            self._ended = True
+            if self._err is not None:
+                err, self._err = self._err, None
+                raise err
+            raise StopIteration
+        self.current_batch = item
+        return item
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+    def stats(self):
+        """Worker-side wall attribution: ``upload_s`` (device_put +
+        readiness wait) vs ``source_s`` (inner-iterator wait)."""
+        return {"upload_s": round(self.upload_s, 3),
+                "source_s": round(self.source_s, 3),
+                "batches_staged": self.batches_staged}
+
+
 def _init_data(data, allow_empty, default_name):
     """Normalize data into a list of (name, numpy) pairs
     (reference ``io.py:424-452``)."""
@@ -811,7 +983,7 @@ class NativeImageRecordIter(DataIter):
                  std_b=1.0, scale=1.0, resize=-1, preprocess_threads=4,
                  part_index=0, num_parts=1, seed=0, data_name="data",
                  label_name="softmax_label", layout="NCHW",
-                 output="ndarray", **kwargs):
+                 output="ndarray", dtype="float32", **kwargs):
         super().__init__(int(batch_size))
         from ._native import dataloader_lib
         import ctypes
@@ -834,6 +1006,21 @@ class NativeImageRecordIter(DataIter):
             raise MXNetError("output must be ndarray or numpy, got %r"
                              % output)
         self.output = output
+        # dtype: "float32" (normalized, reference semantics) or "uint8"
+        # (raw decoded bytes, quarter the host->device traffic; the
+        # trainer casts + normalizes on device).  u8 is only exact when
+        # the loader-side normalization is identity, so refuse otherwise
+        # rather than silently changing the math.
+        if dtype not in ("float32", "uint8"):
+            raise MXNetError("dtype must be float32 or uint8, got %r"
+                             % dtype)
+        if dtype == "uint8" and not (
+                mean_r == mean_g == mean_b == 0.0
+                and std_r == std_g == std_b == 1.0 and scale == 1.0):
+            raise MXNetError(
+                "dtype='uint8' emits raw bytes: mean/std/scale must be "
+                "identity (normalize on device instead)")
+        self.dtype = np.dtype(dtype)
         self.label_width = int(label_width)
         if self.label_width < 1:
             raise MXNetError("label_width must be >= 1")
@@ -866,7 +1053,8 @@ class NativeImageRecordIter(DataIter):
 
     @property
     def provide_data(self):
-        return [DataDesc(self.data_name, self._batch_data_shape)]
+        return [DataDesc(self.data_name, self._batch_data_shape,
+                         self.dtype)]
 
     @property
     def provide_label(self):
@@ -879,12 +1067,18 @@ class NativeImageRecordIter(DataIter):
 
     def next(self):
         import ctypes
-        data = np.empty(self._batch_data_shape, np.float32)
+        data = np.empty(self._batch_data_shape, self.dtype)
         label = np.empty((self.batch_size, self.label_width), np.float32)
-        fresh = self._lib.mxt_loader_next(
-            self._handle,
-            data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
-            label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if self.dtype == np.uint8:
+            fresh = self._lib.mxt_loader_next_u8(
+                self._handle,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        else:
+            fresh = self._lib.mxt_loader_next(
+                self._handle,
+                data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                label.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
         if fresh <= 0:
             raise StopIteration
         if self.label_width == 1:

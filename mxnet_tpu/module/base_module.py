@@ -169,29 +169,62 @@ class BaseModule(object):
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
 
-        for epoch in range(begin_epoch, num_epoch):
-            elapsed = self._train_epoch(epoch, train_data, eval_metric,
-                                        batch_end_callback, monitor)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, elapsed)
-
-            # pull trained values off the devices and refresh host mirrors
-            arg_snap, aux_snap = self.get_params()
-            self.set_params(arg_snap, aux_snap)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_snap, aux_snap)
-
-            if eval_data:
-                for name, val in self.score(
-                        eval_data, validation_metric,
-                        score_end_callback=eval_end_callback,
-                        batch_end_callback=eval_batch_end_callback,
-                        epoch=epoch):
-                    self.logger.info("Epoch[%d] Validation-%s=%f",
+        # fused-trainer path: stage batch N+1's H2D upload while step N
+        # computes (the reference prefetcher's pinned-memory staging,
+        # iter_prefetcher.h:28-129) — see io.DeviceUploadIter
+        staged = self._maybe_overlap_uploads(train_data)
+        wrapped = staged is not train_data
+        train_data = staged
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                elapsed = self._train_epoch(epoch, train_data, eval_metric,
+                                            batch_end_callback, monitor)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f",
                                      epoch, name, val)
-            train_data.reset()
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch, elapsed)
+
+                # pull trained values off the devices and refresh mirrors
+                arg_snap, aux_snap = self.get_params()
+                self.set_params(arg_snap, aux_snap)
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_snap, aux_snap)
+
+                if eval_data:
+                    for name, val in self.score(
+                            eval_data, validation_metric,
+                            score_end_callback=eval_end_callback,
+                            batch_end_callback=eval_batch_end_callback,
+                            epoch=epoch):
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        finally:
+            if wrapped:
+                train_data._shutdown_worker()
+
+    def _maybe_overlap_uploads(self, train_data):
+        """Wrap ``train_data`` in :class:`~mxnet_tpu.io.DeviceUploadIter`
+        when the fused trainer consumes host-side batches, so each
+        batch's device upload overlaps the previous step's compute.
+        Multi-host feeding stays synchronous
+        (``make_array_from_process_local_data`` is a collective); opt
+        out with ``MXTPU_UPLOAD_OVERLAP=0``."""
+        import os
+        from ..io import DeviceUploadIter
+        tr = getattr(self, "_trainer", None)
+        if (tr is None or tr.multihost
+                or isinstance(train_data, DeviceUploadIter)
+                or os.environ.get("MXTPU_UPLOAD_OVERLAP", "1") == "0"):
+            return train_data
+        data_sh = label_sh = None
+        bs = tr._batch_shardings
+        if bs is not None:
+            data_sh = [bs.get(n) for n in self._data_names]
+            label_sh = [bs.get(n) for n in self._label_names]
+        return DeviceUploadIter(train_data, data_shardings=data_sh,
+                                label_shardings=label_sh)
 
     def _train_epoch(self, epoch, train_data, eval_metric,
                      batch_end_callback, monitor):

@@ -231,6 +231,13 @@ class Trainer:
         self._update_fn = update_fn
 
         def _forward(params, aux_vals, batch, key, is_train):
+            # raw-uint8 input batches (NativeImageRecordIter
+            # dtype="uint8"): the float cast happens HERE, on device —
+            # the caller shipped quarter-size bytes over the host link
+            # and the graph still sees float input
+            batch = {n: (v.astype(compute_dtype or jnp.float32)
+                         if v.dtype == jnp.uint8 else v)
+                     for n, v in batch.items()}
             if compute_dtype is not None:
                 params = {n: (v.astype(compute_dtype)
                               if jnp.issubdtype(v.dtype, jnp.floating) else v)

@@ -144,6 +144,15 @@ void Resize(const Image &src, int nh, int nw, Image *dst) {
   }
 }
 
+// pixel store: float output applies the (v - mean)/std * scale
+// normalization; uint8 output is the raw decoded byte — only offered
+// when the normalization is identity (enforced by the python layer), so
+// a consumer can upload quarter-size batches and normalize on-device
+inline void StorePx(float *p, uint8_t v, float m, float s, float sc) {
+  *p = (float(v) - m) / s * sc;
+}
+inline void StorePx(uint8_t *p, uint8_t v, float, float, float) { *p = v; }
+
 // ------------------------------------------------------------- loader
 struct Loader {
   int fd = -1;
@@ -304,9 +313,11 @@ struct Loader {
     }
   }
 
-  // decode + augment one sample into the batch buffers
+  // decode + augment one sample into the batch buffers.  T is the
+  // output pixel type: float (normalized) or uint8_t (raw bytes)
+  template <typename T>
   bool LoadOne(const std::vector<uint8_t> &payload, uint32_t sample_seed,
-               float *data_out, float *label_out) {
+               T *data_out, float *label_out) {
     if (payload.size() < 24) return false;
     uint32_t flag;
     float single_label;
@@ -359,37 +370,71 @@ struct Loader {
       for (int y = 0; y < height; ++y) {
         const uint8_t *row =
             img.rgb.data() + (size_t(y0 + y) * img.w + x0) * 3;
-        float *orow = data_out + size_t(y) * width * channels;
+        T *orow = data_out + size_t(y) * width * channels;
         for (int x = 0; x < width; ++x) {
           int sx = mirror ? (width - 1 - x) : x;
           for (int c = 0; c < channels; ++c) {
             int src_c = channels == 3 ? 2 - c : 0;  // BGR out of RGB
-            orow[size_t(x) * channels + c] =
-                (float(row[size_t(sx) * 3 + src_c]) - mean[c]) / stdv[c] *
-                scale;
+            StorePx(orow + size_t(x) * channels + c,
+                    row[size_t(sx) * 3 + src_c], mean[c], stdv[c], scale);
           }
         }
       }
       return true;
     }
-    // CHW float, BGR order, normalize
+    // CHW, BGR order
     for (int c = 0; c < channels; ++c) {
       int src_c = channels == 3 ? 2 - c : 0;  // BGR out of RGB decode
       float m = mean[c], s = stdv[c];
-      float *plane = data_out + size_t(c) * height * width;
+      T *plane = data_out + size_t(c) * height * width;
       for (int y = 0; y < height; ++y) {
         const uint8_t *row =
             img.rgb.data() + (size_t(y0 + y) * img.w + x0) * 3;
-        float *orow = plane + size_t(y) * width;
+        T *orow = plane + size_t(y) * width;
         for (int x = 0; x < width; ++x) {
           int sx = mirror ? (width - 1 - x) : x;
-          orow[x] = (float(row[size_t(sx) * 3 + src_c]) - m) / s * scale;
+          StorePx(orow + x, row[size_t(sx) * 3 + src_c], m, s, scale);
         }
       }
     }
     return true;
   }
 };
+
+// Fill one batch into T-typed pixel storage.  Returns the number of
+// fresh (non-wrapped) samples: == batch mid-epoch, < batch for the
+// final padded batch, 0 at epoch end.  Corrupt records are zero-filled
+// and counted (mxt_loader_failures) but never end the epoch early —
+// the reference parser likewise skips bad records and keeps going.
+template <typename T>
+int NextImpl(Loader *L, T *data, float *label) {
+  size_t n = L->order.size();
+  if (L->cursor >= n || n == 0) return 0;
+  int fresh = int(std::min<size_t>(L->batch, n - L->cursor));
+  size_t plane = size_t(L->channels) * L->height * L->width;
+  uint32_t epoch_seed = L->seed * 2654435761u + uint32_t(L->epoch);
+  L->ParallelFor(L->batch, [&, n](int i) {
+    size_t idx = L->order[(L->cursor + i) % n];  // wrap-pad to epoch start
+    bool ok = false;
+    try {
+      std::vector<uint8_t> payload;
+      ok = L->ReadRecord(L->records[idx], &payload) &&
+           L->LoadOne(payload, epoch_seed + uint32_t(idx) * 2246822519u,
+                      data + size_t(i) * plane,
+                      label + size_t(i) * L->label_width);
+    } catch (const std::exception &) {
+      ok = false;  // corrupt header driving a huge alloc etc.
+    }
+    if (!ok) {
+      std::memset(data + size_t(i) * plane, 0, plane * sizeof(T));
+      std::memset(label + size_t(i) * L->label_width, 0,
+                  L->label_width * sizeof(float));
+      L->failures.fetch_add(1);
+    }
+  });
+  L->cursor += fresh;
+  return fresh;
+}
 
 }  // namespace
 
@@ -457,39 +502,17 @@ void mxt_loader_reset(void *h) {
   }
 }
 
-// Fill one batch.  Returns the number of fresh (non-wrapped) samples:
-// == batch mid-epoch, < batch for the final padded batch, 0 at epoch end.
-// Corrupt records are zero-filled and counted (mxt_loader_failures) but
-// never end the epoch early — the reference parser likewise skips bad
-// records and keeps going.
+// Fill one float batch (normalized); see NextImpl for the contract.
 int mxt_loader_next(void *h, float *data, float *label) {
-  auto *L = static_cast<Loader *>(h);
-  size_t n = L->order.size();
-  if (L->cursor >= n || n == 0) return 0;
-  int fresh = int(std::min<size_t>(L->batch, n - L->cursor));
-  size_t plane = size_t(L->channels) * L->height * L->width;
-  uint32_t epoch_seed = L->seed * 2654435761u + uint32_t(L->epoch);
-  L->ParallelFor(L->batch, [&, n](int i) {
-    size_t idx = L->order[(L->cursor + i) % n];  // wrap-pad to epoch start
-    bool ok = false;
-    try {
-      std::vector<uint8_t> payload;
-      ok = L->ReadRecord(L->records[idx], &payload) &&
-           L->LoadOne(payload, epoch_seed + uint32_t(idx) * 2246822519u,
-                      data + size_t(i) * plane,
-                      label + size_t(i) * L->label_width);
-    } catch (const std::exception &) {
-      ok = false;  // corrupt header driving a huge alloc etc.
-    }
-    if (!ok) {
-      std::memset(data + size_t(i) * plane, 0, plane * sizeof(float));
-      std::memset(label + size_t(i) * L->label_width, 0,
-                  L->label_width * sizeof(float));
-      L->failures.fetch_add(1);
-    }
-  });
-  L->cursor += fresh;
-  return fresh;
+  return NextImpl(static_cast<Loader *>(h), data, label);
+}
+
+// Fill one raw-uint8 batch — same decode/augment chain, quarter the
+// bytes.  The caller must have created the loader with identity
+// normalization (mean 0 / std 1 / scale 1); the python layer enforces
+// this before choosing the u8 path.
+int mxt_loader_next_u8(void *h, uint8_t *data, float *label) {
+  return NextImpl(static_cast<Loader *>(h), data, label);
 }
 
 // cumulative count of records that failed to read/decode (zero-filled)

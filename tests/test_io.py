@@ -447,3 +447,125 @@ def test_native_nhwc_numpy_feeds_module_fit(tmp_path):
             initializer=mx.init.Xavier())
     it.reset()
     assert mod.score(it, "acc")[0][1] > 0.9
+
+
+def _write_jpeg_rec(tmp_path, name, n, hw=(40, 36), seed=7):
+    from PIL import Image
+    import io as pio
+    rec_path = str(tmp_path / name)
+    rng = np.random.RandomState(seed)
+    rec = recordio.MXRecordIO(rec_path, "w")
+    for i in range(n):
+        img = Image.fromarray(rng.randint(0, 255, hw + (3,),
+                                          dtype=np.uint8))
+        buf = pio.BytesIO()
+        img.save(buf, format="JPEG", quality=95)
+        rec.write(recordio.pack(recordio.IRHeader(0, float(i), i, 0),
+                                buf.getvalue()))
+    rec.close()
+    return rec_path
+
+
+def test_native_loader_uint8_output(tmp_path):
+    """dtype='uint8' ships raw decoded bytes (quarter the H2D traffic);
+    with identity normalization it is value-identical to the float
+    path, and it refuses non-identity normalization rather than
+    silently changing the math."""
+    import pytest
+    from mxnet_tpu.io import NativeImageRecordIter
+    from mxnet_tpu._native import dataloader_lib
+    if dataloader_lib() is None:
+        pytest.skip("native data loader not built")
+    rec_path = _write_jpeg_rec(tmp_path, "u8.rec", 6)
+    common = dict(path_imgrec=rec_path, data_shape=(3, 32, 32),
+                  batch_size=3, rand_crop=True, rand_mirror=True,
+                  layout="NHWC", output="numpy", seed=5)
+    f32 = NativeImageRecordIter(dtype="float32", **common)
+    u8 = NativeImageRecordIter(dtype="uint8", **common)
+    assert u8.provide_data[0].dtype == np.uint8
+    for a, b in zip(f32, u8):
+        assert b.data[0].dtype == np.uint8
+        np.testing.assert_array_equal(a.data[0],
+                                      b.data[0].astype(np.float32))
+        np.testing.assert_array_equal(a.label[0], b.label[0])
+    with pytest.raises(mx.base.MXNetError):
+        NativeImageRecordIter(dtype="uint8", mean_r=123.0, **common)
+    with pytest.raises(mx.base.MXNetError):
+        NativeImageRecordIter(dtype="uint8", scale=1 / 255., **common)
+
+
+def test_device_upload_iter(tmp_path):
+    """DeviceUploadIter stages device-resident batches ahead of the
+    consumer (the H2D half of the reference prefetcher contract,
+    iter_prefetcher.h:28-129): arrays arrive as NDArray, epoch length
+    and order are preserved, reset restarts cleanly, and the staging
+    genuinely runs ahead of consumption."""
+    import time
+    x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    y = np.arange(16, dtype=np.float32)
+    up = io.DeviceUploadIter(io.NDArrayIter(x, y, batch_size=4), depth=2)
+    seen = []
+    for b in up:
+        assert isinstance(b.data[0], mx.nd.NDArray)
+        seen.append(b.data[0].asnumpy())
+    assert len(seen) == 4
+    np.testing.assert_array_equal(np.concatenate(seen, 0), x)
+    up.reset()
+    assert sum(1 for _ in up) == 4
+
+    # run-ahead property: with a slow consumer, the worker has the next
+    # batch staged by the time the consumer asks (queue non-empty)
+    class Slow(io.DataIter):
+        def __init__(self):
+            super().__init__(2)
+            self.n = 0
+            self.provide_data = [io.DataDesc("data", (2, 3))]
+            self.provide_label = [io.DataDesc("softmax_label", (2,))]
+        def next(self):
+            if self.n >= 6:
+                raise StopIteration
+            self.n += 1
+            return io.DataBatch([np.ones((2, 3), np.float32)],
+                                [np.zeros(2, np.float32)], pad=0)
+        def reset(self):
+            self.n = 0
+    up2 = io.DeviceUploadIter(Slow(), depth=2)
+    up2.next()
+    deadline = time.time() + 5.0
+    while up2._q.qsize() == 0 and time.time() < deadline:
+        time.sleep(0.01)
+    assert up2._q.qsize() >= 1       # staged ahead while consumer idle
+    up2._shutdown_worker()
+
+
+def test_fit_wraps_upload_overlap():
+    """Module.fit on the fused path auto-wraps host-side train data in
+    DeviceUploadIter (and tears the worker down afterwards)."""
+    import mxnet_tpu.module.base_module as bm
+    x = np.random.RandomState(0).randn(32, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.float32)
+    it = io.NDArrayIter(x, y, batch_size=8, label_name="softmax_label")
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=2,
+                                name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    os.environ["MXTPU_MODULE_FUSED"] = "always"
+    try:
+        mod = mx.mod.Module(net, context=mx.cpu())
+        wrapped = {}
+        orig = bm.BaseModule._maybe_overlap_uploads
+        def spy(self, td):
+            out = orig(self, td)
+            wrapped["did"] = out is not td
+            wrapped["iter"] = out
+            return out
+        bm.BaseModule._maybe_overlap_uploads = spy
+        try:
+            mod.fit(it, num_epoch=2, optimizer="sgd",
+                    optimizer_params={"learning_rate": 0.1},
+                    initializer=mx.init.Uniform(0.1))
+        finally:
+            bm.BaseModule._maybe_overlap_uploads = orig
+        assert wrapped["did"]
+        assert not wrapped["iter"]._worker.is_alive()   # torn down
+    finally:
+        os.environ.pop("MXTPU_MODULE_FUSED", None)
