@@ -55,6 +55,13 @@ if [ "${MXTPU_CI_FULL:-0}" = "1" ]; then
     # nightly: the sum semantics must hold beyond the 2-worker case
     python tools/launch.py -n 3 --launcher local -- \
         python tests/nightly/dist_sync_kvstore.py
+    # nightly: conv-net dist parity (LeNet + BatchNorm net: cross-rank
+    # lockstep, BN aux-state agreement, serial parity) at 2 AND 3
+    # workers — the reference's dist_lenet/multi_lenet pair
+    python tools/launch.py -n 2 --launcher local -- \
+        python tests/nightly/dist_lenet.py
+    python tools/launch.py -n 3 --launcher local -- \
+        python tests/nightly/dist_lenet.py
 fi
 
 stage "crash-restart recovery (auto-restart orchestration)"

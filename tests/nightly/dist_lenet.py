@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Distributed CONV-net training parity (reference
+``tests/nightly/dist_lenet.py`` + ``multi_lenet.py``): LeNet and a
+BatchNorm-bearing conv net trained under multi-process
+``kvstore=dist_sync_tpu`` through the fused global-mesh path —
+Convolution + Pooling (+ BatchNorm) have to hold the same dist_sync
+exactness contract the MLP tests prove for dense layers.
+
+Run:  python tools/launch.py -n 2 --launcher local -- \\
+          python tests/nightly/dist_lenet.py
+
+Asserts, on every rank, for BOTH nets:
+  * convergence on the sharded synthetic image task;
+  * parameters bit-identical across ranks after training;
+  * **BatchNorm aux states (moving_mean / moving_var) identical across
+    ranks** — the interesting conv-net case: batch statistics are
+    reduced over the GLOBAL batch inside the fused step, so every rank's
+    running stats must agree exactly, not merely approximately;
+  * parameter + aux parity with a SERIAL single-process run over the
+    same global batches (the single-process accuracy-parity contract,
+    checked at the strength of the weights themselves).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+os.environ["MXTPU_MODULE_FUSED"] = "always"   # CPU CI: force fused path
+
+import numpy as np
+
+EPOCHS = 5
+LOCAL_BATCH = 32
+# divisible by LOCAL_BATCH * nworker for nworker in {2, 3}: every shard
+# is whole batches, so the serial-parity check compares identical row
+# sets (a padded final batch would train extra duplicated rows)
+N = 576
+IMG = 12
+
+
+def _lenet(mx, bn=False):
+    """LeNet-shaped conv net (conv-pool-conv-pool-fc-fc); ``bn=True``
+    inserts BatchNorm after each convolution."""
+    net = mx.sym.Variable("data")
+    net = mx.sym.Convolution(net, num_filter=8, kernel=(3, 3), name="c1")
+    if bn:
+        net = mx.sym.BatchNorm(net, name="bn1")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Convolution(net, num_filter=16, kernel=(3, 3), name="c2")
+    if bn:
+        net = mx.sym.BatchNorm(net, name="bn2")
+    net = mx.sym.Activation(net, act_type="tanh")
+    net = mx.sym.Pooling(net, kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data():
+    """4-class synthetic images: a distinct spatial pattern per class
+    (+ noise) so a conv net separates them quickly.  Same draw on every
+    worker (fixed seed); workers shard by rank."""
+    rng = np.random.RandomState(11)
+    X = rng.normal(0, 0.35, (N, 1, IMG, IMG)).astype("f")
+    Y = rng.randint(0, 4, N).astype("f")
+    half = IMG // 2
+    for i, y in enumerate(Y.astype(int)):
+        r, c = divmod(y, 2)
+        X[i, 0, r * half:(r + 1) * half, c * half:(c + 1) * half] += 1.0
+    return X, Y
+
+
+def _init_params(mx, sym):
+    rng = np.random.RandomState(42)
+    shapes, _, _ = sym.infer_shape(data=(LOCAL_BATCH, 1, IMG, IMG),
+                                   softmax_label=(LOCAL_BATCH,))
+    args = {}
+    for name, shape in zip(sym.list_arguments(), shapes):
+        if name in ("data", "softmax_label"):
+            continue
+        if name.endswith("_gamma"):
+            args[name] = mx.nd.ones(shape)
+        elif name.endswith(("_beta", "_bias")):
+            args[name] = mx.nd.zeros(shape)
+        else:
+            args[name] = mx.nd.array(rng.normal(0, 0.2, shape).astype("f"))
+    return args
+
+
+def _assert_same_across_ranks(params, nworker, what):
+    # compare against rank 0's copy (exact: a mean over nworker ranks
+    # would round for any nworker that is not a power of two)
+    from mxnet_tpu.parallel.collectives import broadcast_from_rank0
+    for name in sorted(params):
+        mine = params[name].asnumpy()
+        ref = np.asarray(broadcast_from_rank0(mine))
+        np.testing.assert_array_equal(
+            mine, ref.astype(mine.dtype),
+            err_msg="%s %s differs across ranks" % (what, name))
+
+
+def _run_one(mx, kv, bn):
+    rank, nworker = kv.rank, kv.num_workers
+    X, Y = _data()
+    Xs, Ys = X[rank::nworker], Y[rank::nworker]
+
+    sym = _lenet(mx, bn=bn)
+    args0 = _init_params(mx, sym)
+
+    it = mx.io.NDArrayIter(Xs, Ys, batch_size=LOCAL_BATCH, shuffle=False)
+    mod = mx.mod.Module(sym)
+    mod.fit(it, num_epoch=EPOCHS, kvstore=kv,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.3, "rescale_grad":
+                              1.0 / (LOCAL_BATCH * nworker)},
+            arg_params={k: v.copy() for k, v in args0.items()},
+            allow_missing=False, initializer=mx.init.Zero())
+
+    assert mod._trainer is not None, "rank %d fell back to classic" % rank
+    assert mod._trainer.multihost, "rank %d trainer is single-host" % rank
+
+    it.reset()
+    acc = dict(mod.score(it, mx.metric.Accuracy()))["accuracy"]
+    assert acc > 0.9, "rank %d: %s acc %.3f" % (rank, "bn-lenet" if bn
+                                                else "lenet", acc)
+
+    arg_params, aux_params = mod.get_params()
+    # (2) lockstep across ranks — params AND BatchNorm running stats
+    _assert_same_across_ranks(arg_params, nworker, "param")
+    if bn:
+        assert any("moving_mean" in n for n in aux_params), \
+            "bn net reported no moving stats"
+        _assert_same_across_ranks(aux_params, nworker, "bn aux")
+        # the stats must have genuinely moved off their init
+        mm = np.concatenate([aux_params[n].asnumpy().ravel()
+                             for n in aux_params if "moving_mean" in n])
+        assert np.abs(mm).max() > 1e-4, "moving_mean never updated"
+
+    # (3) parity with a serial single-process run over the same global
+    # batches (global batch k = concat over ranks of each rank's k-th
+    # local batch).  BN batch statistics reduce over the global batch in
+    # the fused step, so the serial run sees the identical row sets and
+    # the weights AND moving stats must match to float tolerance.
+    nb = len(Xs) // LOCAL_BATCH
+    rows = np.concatenate([
+        np.concatenate([np.arange(r, len(X), nworker)
+                        [k * LOCAL_BATCH:(k + 1) * LOCAL_BATCH]
+                        for r in range(nworker)])
+        for k in range(nb)])
+    sit = mx.io.NDArrayIter(X[rows], Y[rows],
+                            batch_size=LOCAL_BATCH * nworker,
+                            shuffle=False)
+    smod = mx.mod.Module(_lenet(mx, bn=bn), context=mx.cpu())
+    smod.fit(sit, num_epoch=EPOCHS,
+             optimizer="sgd",
+             optimizer_params={"learning_rate": 0.3, "rescale_grad":
+                               1.0 / (LOCAL_BATCH * nworker)},
+             arg_params={k: v.copy() for k, v in args0.items()},
+             allow_missing=False, initializer=mx.init.Zero())
+    serial_arg, serial_aux = smod.get_params()
+    # BN batch statistics reduce in a different association order on the
+    # sharded mesh (per-shard psum tree) than in the serial run; the
+    # rsqrt feedback compounds that float noise over the epochs, so the
+    # BN net gets a looser — still parity-proving — tolerance.  The
+    # cross-rank lockstep assertion above stays bit-exact either way.
+    rtol, atol = (5e-3, 1e-3) if bn else (5e-4, 5e-5)
+    for name in sorted(arg_params):
+        np.testing.assert_allclose(
+            arg_params[name].asnumpy(), serial_arg[name].asnumpy(),
+            rtol=rtol, atol=atol,
+            err_msg="dist %s diverged from serial" % name)
+    for name in sorted(aux_params):
+        np.testing.assert_allclose(
+            aux_params[name].asnumpy(), serial_aux[name].asnumpy(),
+            rtol=rtol, atol=atol,
+            err_msg="dist aux %s diverged from serial" % name)
+    return acc
+
+
+def main():
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create("dist_sync_tpu")
+    rank, nworker = kv.rank, kv.num_workers
+    assert nworker > 1, "run under the launcher"
+
+    acc_plain = _run_one(mx, kv, bn=False)
+    acc_bn = _run_one(mx, kv, bn=True)
+
+    kv._barrier()
+    print("worker %d/%d: dist lenet acc=%.3f, bn-lenet acc=%.3f; params, "
+          "BN aux states, and serial parity all verified"
+          % (rank, nworker, acc_plain, acc_bn), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
