@@ -310,46 +310,60 @@ def CastAug():
     return aug
 
 
+# ImageNet RGB statistics and PCA lighting basis — the constants the
+# reference augmenter chain bakes in (mean=True/std=True select them)
+_IMAGENET_RGB_MEAN = np.array([123.68, 116.28, 103.53])
+_IMAGENET_RGB_STD = np.array([58.395, 57.12, 57.375])
+_IMAGENET_PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+_IMAGENET_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.8140],
+                                 [-0.5836, -0.6948, 0.4203]])
+
+
+def _channel_stat(value, imagenet_default):
+    """``True`` -> the ImageNet constant; ``None`` -> disabled; anything
+    else -> a 1- or 3-channel array."""
+    if value is True:
+        return imagenet_default
+    if value is None:
+        return None
+    value = _to_np(value)
+    assert value.shape[0] in (1, 3)
+    return value
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, pca_noise=0, inter_method=2):
-    """Assemble the standard training augmenter list (``image.py:272-318``)."""
-    auglist = []
+    """Assemble the standard training augmentation pipeline in the
+    reference's fixed stage order (contract of ``image.py:272-318``):
+    resize -> crop -> flip -> cast -> color jitter -> pca lighting ->
+    normalize."""
+    pipeline = []
     if resize > 0:
-        auglist.append(ResizeAug(resize, inter_method))
-    crop_size = (data_shape[2], data_shape[1])
+        pipeline.append(ResizeAug(resize, inter_method))
+    crop = (data_shape[2], data_shape[1])
     if rand_resize:
         assert rand_crop
-        auglist.append(RandomSizedCropAug(crop_size, 0.3, (3.0 / 4.0, 4.0 / 3.0),
-                                          inter_method))
-    elif rand_crop:
-        auglist.append(RandomCropAug(crop_size, inter_method))
+        pipeline.append(RandomSizedCropAug(crop, 0.3,
+                                           (3.0 / 4.0, 4.0 / 3.0),
+                                           inter_method))
     else:
-        auglist.append(CenterCropAug(crop_size, inter_method))
+        pipeline.append(RandomCropAug(crop, inter_method) if rand_crop
+                        else CenterCropAug(crop, inter_method))
     if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
+        pipeline.append(HorizontalFlipAug(0.5))
+    pipeline.append(CastAug())
     if brightness or contrast or saturation:
-        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+        pipeline.append(ColorJitterAug(brightness, contrast, saturation))
     if pca_noise > 0:
-        eigval = np.array([55.46, 4.794, 1.148])
-        eigvec = np.array([[-0.5675, 0.7192, 0.4009],
-                           [-0.5808, -0.0045, -0.8140],
-                           [-0.5836, -0.6948, 0.4203]])
-        auglist.append(LightingAug(pca_noise, eigval, eigvec))
-    if mean is True:
-        mean = np.array([123.68, 116.28, 103.53])
-    elif mean is not None:
-        mean = _to_np(mean)
-        assert mean.shape[0] in [1, 3]
-    if std is True:
-        std = np.array([58.395, 57.12, 57.375])
-    elif std is not None:
-        std = _to_np(std)
-        assert std.shape[0] in [1, 3]
+        pipeline.append(LightingAug(pca_noise, _IMAGENET_PCA_EIGVAL,
+                                    _IMAGENET_PCA_EIGVEC))
+    mean = _channel_stat(mean, _IMAGENET_RGB_MEAN)
+    std = _channel_stat(std, _IMAGENET_RGB_STD)
     if mean is not None or std is not None:
-        auglist.append(ColorNormalizeAug(mean, std))
-    return auglist
+        pipeline.append(ColorNormalizeAug(mean, std))
+    return pipeline
 
 
 class ImageIter(_io.DataIter):
@@ -452,26 +466,27 @@ class ImageIter(_io.DataIter):
         self.cur = 0
 
     def next_sample(self):
-        """Return ``(label, decoded-image NDArray)`` for the next sample
-        (``image.py:454-477``)."""
-        if self.seq is not None:
-            if self.cur >= len(self.seq):
+        """``(label, raw image bytes)`` for the next sample — sequence
+        order when a shuffle/list sequence exists, raw record-stream
+        order otherwise (contract of ``image.py:454-477``; labels from
+        the ``.lst`` list override the record header's)."""
+        if self.seq is None:
+            rec = self.imgrec.read()          # pure record-stream mode
+            if rec is None:
                 raise StopIteration
-            idx = self.seq[self.cur]
-            self.cur += 1
-            if self.imgrec is not None:
-                s = self.imgrec.read_idx(idx)
-                header, img = recordio.unpack(s)
-                if self.imglist is None:
-                    return header.label, img
-                return self.imglist[idx][0], img
+            header, img = recordio.unpack(rec)
+            return header.label, img
+        if self.cur >= len(self.seq):
+            raise StopIteration
+        idx = self.seq[self.cur]
+        self.cur += 1
+        if self.imgrec is None:               # loose-image mode
             label, fname = self.imglist[idx]
             return label, self.read_image(fname)
-        s = self.imgrec.read()
-        if s is None:
-            raise StopIteration
-        header, img = recordio.unpack(s)
-        return header.label, img
+        header, img = recordio.unpack(self.imgrec.read_idx(idx))
+        label = header.label if self.imglist is None \
+            else self.imglist[idx][0]
+        return label, img
 
     def next(self):
         batch_size = self.batch_size

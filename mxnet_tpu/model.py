@@ -56,37 +56,46 @@ def _create_kvstore(kvstore, num_device, arg_params):
 
 def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
                         update_on_kvstore):
-    """Initialize kvstore (reference ``model.py:70-86``)."""
-    for idx, param_on_devs in enumerate(param_arrays):
-        kvstore.init(idx, arg_params[param_names[idx]])
+    """Seed the store with the host-side init values, one key per
+    parameter index (reference contract ``model.py:70-86``).  When the
+    store owns the optimizer, the freshly-seeded value is pulled
+    straight back onto every device copy so all replicas start from the
+    store's canonical weights."""
+    for idx, (name, dev_copies) in enumerate(zip(param_names,
+                                                 param_arrays)):
+        kvstore.init(idx, arg_params[name])
         if update_on_kvstore:
-            kvstore.pull(idx, param_on_devs, priority=-idx)
+            kvstore.pull(idx, dev_copies, priority=-idx)
 
 
 def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore):
-    """Push grads / pull updated weights (reference ``model.py:88-99``)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
+    """Store-side update: push this step's gradients, pull back the
+    store-updated weights (reference contract ``model.py:88-99``).
+    Frozen parameters (gradient slot ``None``) never touch the store."""
+    for idx, (weights, grads) in enumerate(zip(param_arrays,
+                                               grad_arrays)):
+        if grads[0] is None:
             continue
-        kvstore.push(index, grad_list, priority=-index)
-        kvstore.pull(index, arg_list, priority=-index)
+        kvstore.push(idx, grads, priority=-idx)
+        kvstore.pull(idx, weights, priority=-idx)
 
 
 def _update_params(param_arrays, grad_arrays, updater, num_device,
                    kvstore=None):
-    """Aggregate grads (optionally via kvstore) then run the local updater
-    per device (reference ``model.py:99-116``)."""
-    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
-        arg_list, grad_list = pair
-        if grad_list[0] is None:
+    """Local-updater path (reference contract ``model.py:99-116``):
+    optionally aggregate through the store first — push then pull
+    leaves the cross-device SUM in the gradient buffers — then apply
+    the python updater to each device copy under the reference's
+    ``index * num_device + device`` state-key scheme."""
+    for idx, (weights, grads) in enumerate(zip(param_arrays,
+                                               grad_arrays)):
+        if grads[0] is None:
             continue
         if kvstore:
-            kvstore.push(index, grad_list, priority=-index)
-            kvstore.pull(index, grad_list, priority=-index)
-        for k, p in enumerate(zip(arg_list, grad_list)):
-            w, g = p
-            updater(index * num_device + k, g, w)
+            kvstore.push(idx, grads, priority=-idx)
+            kvstore.pull(idx, grads, priority=-idx)
+        for dev, (w, g) in enumerate(zip(weights, grads)):
+            updater(idx * num_device + dev, g, w)
 
 
 def _atomic_save(path, save_dict):

@@ -491,20 +491,19 @@ class Module(BaseModule):
                     self._preload_opt_states = None
                 return
 
-        kvstore, update_on_kvstore = _create_kvstore(
+        self._kvstore, self._update_on_kvstore = _create_kvstore(
             kvstore, len(self._context), self._arg_params)
-        self._kvstore = kvstore
-        self._update_on_kvstore = update_on_kvstore
-        self._updater = None
 
-        if kvstore:
-            _initialize_kvstore(kvstore=kvstore,
-                                param_arrays=self._exec_group.param_arrays,
-                                arg_params=self._arg_params,
-                                param_names=self._param_names,
-                                update_on_kvstore=update_on_kvstore)
-        if update_on_kvstore:
-            kvstore.set_optimizer(self._optimizer)
+        if self._kvstore:
+            # seed the store with the host init values (one key per
+            # parameter; store-side optimizers pull them back to devices)
+            _initialize_kvstore(self._kvstore,
+                                self._exec_group.param_arrays,
+                                self._arg_params, self._param_names,
+                                self._update_on_kvstore)
+        if self._update_on_kvstore:
+            self._updater = None
+            self._kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
 

@@ -385,20 +385,27 @@ create = Optimizer.create_optimizer
 
 
 class Updater(object):
-    """Per-index state holder bridging KVStore's ``(key, grad, weight)``
-    callback to an Optimizer."""
+    """Bridges KVStore's ``(key, grad, weight)`` callback onto an
+    Optimizer, materializing each key's optimizer state lazily on first
+    touch (the role of the reference's updater closure).  State pickles
+    round-trip through ``get_states``/``set_states`` for checkpointing;
+    a key's state may legitimately be ``None`` (stateless rules like
+    plain SGD)."""
 
     def __init__(self, optimizer):
         self.optimizer = optimizer
         self.states = {}
 
     def __call__(self, index, grad, weight):
-        if index not in self.states:
-            self.states[index] = self.optimizer.create_state(index, weight)
-        self.optimizer.update(index, weight, grad, self.states[index])
+        try:
+            state = self.states[index]
+        except KeyError:
+            state = self.optimizer.create_state(index, weight)
+            self.states[index] = state
+        self.optimizer.update(index, weight, grad, state)
 
-    def set_states(self, states):
-        self.states = pickle.loads(states)
+    def set_states(self, blob):
+        self.states = pickle.loads(blob)
 
     def get_states(self):
         return pickle.dumps(self.states)

@@ -92,19 +92,16 @@ class BaseRNNCell(object):
     """Abstract RNN cell (reference ``rnn_cell.py:90-315``)."""
 
     def __init__(self, prefix="", params=None):
-        if params is None:
-            params = RNNParams(prefix)
-            self._own_params = True
-        else:
-            self._own_params = False
-        self._prefix = prefix
-        self._params = params
-        self._modified = False
         self.reset()
+        self._prefix = prefix
+        self._modified = False
+        # a cell owns its parameter container iff it created it; shared
+        # containers (weight tying across cells) are never re-owned
+        self._own_params = params is None
+        self._params = RNNParams(prefix) if params is None else params
 
     def reset(self):
-        self._init_counter = -1
-        self._counter = -1
+        self._init_counter = self._counter = -1
 
     def __call__(self, inputs, states):
         raise NotImplementedError()
@@ -125,6 +122,15 @@ class BaseRNNCell(object):
     @property
     def _gate_names(self):
         return ()
+
+    def _fetch_projection_params(self, i2h_bias_init=None):
+        """Materialize the fused input/hidden projection variables
+        (the i2h/h2h weight+bias quartet every gated cell shares)."""
+        get = self.params.get
+        self._iW, self._hW = get("i2h_weight"), get("h2h_weight")
+        self._iB = get("i2h_bias", **({"init": i2h_bias_init}
+                                      if i2h_bias_init is not None else {}))
+        self._hB = get("h2h_bias")
 
     def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified, \
@@ -216,10 +222,7 @@ class RNNCell(BaseRNNCell):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
         self._activation = activation
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._fetch_projection_params()
 
     @property
     def state_info(self):
@@ -245,12 +248,9 @@ class LSTMCell(BaseRNNCell):
                  forget_bias=1.0):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._hW = self.params.get("h2h_weight")
         from ..initializer import LSTMBias
-        self._iB = self.params.get("i2h_bias",
-                                   init=LSTMBias(forget_bias=forget_bias))
-        self._hB = self.params.get("h2h_bias")
+        self._fetch_projection_params(
+            i2h_bias_init=LSTMBias(forget_bias=forget_bias))
 
     @property
     def state_info(self):
@@ -286,10 +286,7 @@ class GRUCell(BaseRNNCell):
     def __init__(self, num_hidden, prefix="gru_", params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_hidden = num_hidden
-        self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
-        self._hW = self.params.get("h2h_weight")
-        self._hB = self.params.get("h2h_bias")
+        self._fetch_projection_params()
 
     @property
     def state_info(self):
@@ -479,17 +476,20 @@ class ModifierCell(BaseRNNCell):
     def state_info(self):
         return self.base_cell.state_info
 
-    def begin_state(self, init_sym=symbol.zeros, **kwargs):
-        assert not self._modified
-        self.base_cell._modified = False
-        begin = self.base_cell.begin_state(func=init_sym, **kwargs)
-        self.base_cell._modified = True
-        return begin
-
-    def unpack_weights(self, args):
+    def unpack_weights(self, args):        # checkpoint I/O delegates to
         return self.base_cell.unpack_weights(args)
 
-    def pack_weights(self, args):
+    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+        assert not self._modified
+        # momentarily lift the modified flag so the base cell accepts
+        # the call, then re-seal it
+        try:
+            self.base_cell._modified = False
+            return self.base_cell.begin_state(func=init_sym, **kwargs)
+        finally:
+            self.base_cell._modified = True
+
+    def pack_weights(self, args):          # the wrapped cell's layout
         return self.base_cell.pack_weights(args)
 
     def __call__(self, inputs, states):

@@ -25,29 +25,32 @@ from mxnet_tpu import recordio
 
 
 def list_image(root, recursive, exts):
-    """Yield (index, relpath, label) walking ``root``; one label id per
-    subdirectory in sorted order (reference ``im2rec.py list_image``)."""
-    i = 0
-    if recursive:
-        cat = {}
-        for path, dirs, files in os.walk(root, followlinks=True):
-            dirs.sort()
-            files.sort()
-            for fname in files:
-                fpath = os.path.join(path, fname)
-                suffix = os.path.splitext(fname)[1].lower()
-                if os.path.isfile(fpath) and suffix in exts:
-                    if path not in cat:
-                        cat[path] = len(cat)
-                    yield (i, os.path.relpath(fpath, root), cat[path])
-                    i += 1
-    else:
-        for fname in sorted(os.listdir(root)):
-            fpath = os.path.join(root, fname)
-            suffix = os.path.splitext(fname)[1].lower()
-            if os.path.isfile(fpath) and suffix in exts:
-                yield (i, os.path.relpath(fpath, root), 0)
-                i += 1
+    """Yield ``(index, relpath, label)`` for every image under ``root``
+    — the ``.lst`` contract of the reference tool (``im2rec.py``): one
+    label id per directory in first-encounter order of a sorted
+    depth-first walk (symlinked class directories followed, the common
+    ImageNet layout); label 0 for a flat listing."""
+    if not recursive:
+        from pathlib import Path
+        images = sorted(p for p in Path(root).iterdir()
+                        if p.suffix.lower() in exts and p.is_file())
+        for i, p in enumerate(images):
+            yield (i, p.name, 0)
+        return
+    index = 0
+    label_of = {}
+    for path, dirs, files in os.walk(root, followlinks=True):
+        dirs.sort()
+        hits = [f for f in sorted(files)
+                if os.path.splitext(f)[1].lower() in exts
+                and os.path.isfile(os.path.join(path, f))]
+        if not hits:
+            continue
+        label = label_of.setdefault(path, len(label_of))
+        for fname in hits:
+            yield (index, os.path.relpath(os.path.join(path, fname), root),
+                   label)
+            index += 1
 
 
 def write_list(path_out, image_list):
