@@ -7,48 +7,48 @@ loop: ``forward(is_train=True)``, ``update()``, ``update_metric``), with
 fwd+bwd+allreduce+SGD-momentum update as ONE jitted XLA computation, bf16
 compute with f32 master weights.
 
+Real-data pipeline, measured in TWO configurations (docs/how_to/perf.md
+"Input pipeline"):
+
+* **cached** (the TPU-native steady state): the decoded dataset lives in
+  HBM (``io.DeviceCacheIter``); per-batch host traffic is one index
+  vector, crop/mirror run on-chip.  This is the headline
+  ``pipeline_img_per_sec``.
+* **stream** (datasets beyond device memory): RecordIO -> native C++
+  JPEG decode -> uint8 NHWC host batch -> one upload per batch, paced
+  by the tunnel's wire rate (15-80 MB/s weather), reported as
+  ``stream_*`` fields.
+
+Each timed window is preceded by TWO drain-closed warmup cycles: the
+tunnel transport dispatches a program's calls by value for that
+program's first two execute+drain cycles and by reference (~20x
+faster) afterwards — measured and documented in docs/how_to/perf.md
+("The tunnel transport's measured semantics").
+
 Baseline: the reference's best published single-device number — ResNet-50
 batch-32 training on P100, 181.53 img/s (``docs/how_to/perf.md:151-183``,
 copied in BASELINE.md).  Prints ONE JSON line.
 """
 import json
 import os
+
 import sys
 import time
 
 import numpy as np
 
 BASELINE_IMG_S = 181.53  # reference single-P100 ResNet-50 train, batch 32
+PIPE_BATCH = 256
+PIPE_IMAGES = 512
 
 
-def _pipeline_bench(mx, mod, metric, staged_img_s, n_images=512, batch=256,
-                    steps=None):
-    """Feed the already-compiled train step from the real input pipeline:
-    RecordIO -> native C++ JPEG decode pool (decoding straight into NHWC
-    **uint8** — quarter the host->device bytes; the fused step casts on
-    device) -> PrefetchingIter (decode overlap) -> DeviceUploadIter
-    (batch N+1's H2D staged while step N computes) -> fused step.
+def _pipe_steps():
+    return int(os.environ.get("MXTPU_BENCH_PIPELINE_STEPS", "24"))
 
-    Emits a per-stage budget checkable against the host caps:
-    ``decode_img_per_sec`` (loader alone), ``h2d_s_per_batch`` (median
-    one-batch upload over ``h2d_probes`` probes, spread reported), and
-    the bound ``min(decode, h2d, staged)``.  The timed loop is decomposed
-    into NAMED contiguous parts — ``input_wait_s`` (staged-batch wait),
-    ``dispatch_s`` (step dispatch), ``metric_s``, ``tail_barrier_s`` —
-    that sum to the elapsed wall (``budget_coverage``); the upload
-    worker's own wall split (``upload_s`` vs ``source_s``) attributes
-    what input_wait was made of.  Window: MXTPU_BENCH_PIPELINE_STEPS,
-    default 24 (an idle-host capture needs the larger window to beat the
-    tunnel's ±25% transfer jitter; CI may shrink it)."""
-    import jax
-    import numpy as np
-    from mxnet_tpu import io, recordio
-    from mxnet_tpu.io import (DeviceUploadIter, NativeImageRecordIter,
-                              PrefetchingIter, ResizeIter)
 
-    if steps is None:
-        steps = int(os.environ.get("MXTPU_BENCH_PIPELINE_STEPS", "24"))
-
+def _ensure_rec(n_images=PIPE_IMAGES):
+    """Synthetic 256x256 JPEG RecordIO file (created once, reused)."""
+    from mxnet_tpu import recordio
     rec_path = "/tmp/mxtpu_bench_%d.rec" % n_images
     if not os.path.exists(rec_path):
         from PIL import Image
@@ -65,6 +65,146 @@ def _pipeline_bench(mx, mod, metric, staged_img_s, n_images=512, batch=256,
                 recordio.IRHeader(0, float(i % 1000), i, 0), buf.getvalue()))
         rec.close()
         os.rename(tmp_path, rec_path)   # atomic: no truncated cache reuse
+    return rec_path
+
+
+def _build_module(mx, models, batch, image, ctx=None):
+    # channels-last: the TPU-native layout (lanes = channels keeps convs
+    # on the MXU without relayout transposes); ~6% over NCHW here.  The
+    # remaining ceiling is HBM bandwidth: tools/roofline.py measures this
+    # chip at ~181 TF/s bf16 / ~587 GB/s (ROOFLINE.json); XLA's cost
+    # analysis puts the step's byte traffic at the bandwidth roofline, so
+    # the step runs ~30% MFU — ResNet's low-arithmetic-intensity stages
+    # (stem, BN, early blocks) are bandwidth-bound, not MXU-bound.
+    sym = models.get_symbol("resnet-50", num_classes=1000, layout="NHWC")
+    mod = mx.mod.Module(context=ctx if ctx is not None else mx.tpu(),
+                        symbol=sym, compute_dtype="bfloat16")
+    mod.bind(data_shapes=[("data", (batch, image, image, 3))],
+             label_shapes=[("softmax_label", (batch,))])
+    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
+                                   magnitude=2))
+    kv = mx.kvstore.create("dist_sync_tpu")
+    mod.init_optimizer(kvstore=kv, optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1,
+                                         "momentum": 0.9,
+                                         "rescale_grad": 1.0 / batch})
+    assert mod._trainer is not None, "bench must measure the fused path"
+    return mod
+
+
+def _timed_window(mod, metric, next_batch, steps, batch):
+    """One pipeline window with the NAMED contiguous budget.
+
+    TWO warmup cycles, each closed by a ``metric.get()`` drain: the
+    first compiles the step program; the second exists because the
+    tunnel transport dispatches a program's calls by value for the
+    first two execute+drain cycles of the process and switches to
+    reference dispatch (~20x faster) from the third — measured:
+    1-step cycle 5.6 img/s, 5-step cycle 38 img/s, every later cycle
+    ~2,200 img/s sustained (perf.md "host reads").  The timed window is
+    therefore cycle 3+.  The window's closing ``metric.get()`` is the
+    completion barrier (``block_until_ready`` does not block on this
+    transport): it drains every queued upload and step, so ``elapsed``
+    covers all the real work.  Budget parts sum to elapsed by
+    construction (``budget_coverage``); upload/wire time that overlaps
+    dispatch shows up in the dispatch and tail slots."""
+    for warm_n in (1, 3):
+        for _ in range(warm_n):
+            b = next_batch()
+            mod.forward(b, is_train=True)
+            mod.update()
+            mod.update_metric(metric, b.label)
+        metric.get()
+        metric.reset()
+
+    in_s = disp_s = met_s = 0.0
+    fresh = 0
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        t1 = time.perf_counter()
+        b = next_batch()
+        t2 = time.perf_counter()
+        fresh += batch - (b.pad or 0)  # count only real images
+        mod.forward(b, is_train=True)
+        mod.update()
+        t3 = time.perf_counter()
+        mod.update_metric(metric, b.label)
+        t4 = time.perf_counter()
+        in_s += t2 - t1
+        disp_s += t3 - t2
+        met_s += t4 - t3
+    metric.get()                       # the draining completion barrier
+    elapsed = time.perf_counter() - t0
+    tail_s = elapsed - in_s - disp_s - met_s
+    return {
+        "img_per_sec": round(fresh / elapsed, 2),
+        "steps_timed": steps,
+        "budget_input_wait_s_per_batch": round(in_s / steps, 3),
+        "budget_dispatch_s_per_batch": round(disp_s / steps, 3),
+        "budget_metric_s_per_batch": round(met_s / steps, 3),
+        "budget_tail_barrier_s_per_batch": round(tail_s / steps, 3),
+        "budget_coverage": round((in_s + disp_s + met_s + tail_s)
+                                 / elapsed, 3),
+    }
+
+
+def _cycling(it):
+    """next_batch() that wraps epochs (and resets the epoch iterator)."""
+    def next_batch():
+        try:
+            return it.next()
+        except StopIteration:
+            it.reset()
+            return it.next()
+    return next_batch
+
+
+def _cached_pipeline(mx, mod, metric, steps=None, batch=PIPE_BATCH):
+    """HBM-cached real-data pipeline (io.DeviceCacheIter): decode the
+    RecordIO set once at storage size, upload once, then gather +
+    random-crop + mirror ON CHIP per batch.  Steady-state host traffic:
+    one int32 index vector per batch."""
+    from mxnet_tpu.io import DeviceCacheIter, NativeImageRecordIter
+
+    steps = _pipe_steps() if steps is None else steps
+    rec_path = _ensure_rec()
+    loader = NativeImageRecordIter(
+        path_imgrec=rec_path, data_shape=(3, 256, 256), batch_size=batch,
+        layout="NHWC", output="numpy", dtype="uint8",
+        preprocess_threads=max(2, os.cpu_count() or 1))
+    t0 = time.perf_counter()
+    it = DeviceCacheIter(loader, data_shape=(224, 224), rand_crop=True,
+                         rand_mirror=True, shuffle=True, seed=7)
+    build_s = time.perf_counter() - t0
+
+    win = _timed_window(mod, metric, _cycling(it), steps, batch)
+    out = {"pipeline_img_per_sec": win.pop("img_per_sec"),
+           "pipeline_steps_timed": win.pop("steps_timed"),
+           "cache_build_s": round(build_s, 2),
+           "cache_mb": round(it.cache_nbytes() / 1e6, 1),
+           "cache_images": it.num_data}
+    out.update({"pipeline_" + k if not k.startswith("budget") else k: v
+                for k, v in win.items()})
+    return out
+
+
+def _stream_pipeline(mx, mod, metric, staged_img_s, steps=None,
+                     batch=PIPE_BATCH):
+    """Streaming real-data pipeline (datasets beyond HBM): RecordIO ->
+    native C++ JPEG decode pool (straight into NHWC uint8 — quarter the
+    f32 bytes; the fused step casts on device) -> PrefetchingIter
+    (decode overlap) -> one upload per batch inside the trainer.
+
+    The upload is synchronous in the trainer: on this transport the
+    client serializes in-flight operations, so a staging thread cannot
+    overlap the wire with compute (measured — thread-staged configs
+    time equal-or-worse; perf.md).  The per-batch wire time therefore
+    shows up in the dispatch/input slots of the budget."""
+    import jax
+    from mxnet_tpu.io import NativeImageRecordIter, PrefetchingIter
+
+    steps = _pipe_steps() if steps is None else steps
+    rec_path = _ensure_rec()
 
     def make_iter():
         return NativeImageRecordIter(
@@ -75,8 +215,7 @@ def _pipeline_bench(mx, mod, metric, staged_img_s, n_images=512, batch=256,
 
     # stage budget 1: raw decode rate (loader alone, no model, no H2D).
     # The loader decodes EVERY slot of a batch (wrap-padding included),
-    # so a timed call is worth `batch` decodes regardless of pad —
-    # n_images is a multiple of batch anyway, so epochs divide evenly.
+    # so a timed call is worth `batch` decodes regardless of pad.
     raw = make_iter()
     next(iter(raw))                                     # pool warmup
     t0 = time.perf_counter()
@@ -89,11 +228,10 @@ def _pipeline_bench(mx, mod, metric, staged_img_s, n_images=512, batch=256,
             raw.reset()
     decode_img_s = dec_images / (time.perf_counter() - t0)
 
-    # stage budget 2: one-batch H2D through the tunnel, at the bytes the
-    # pipeline actually ships (uint8).  The tunnel's rate fluctuates
-    # ~±25% between transfers, so take the median of several probes and
-    # report count + spread — a single probe mislabels that variance as
-    # pipeline overhead.
+    # stage budget 2: HOST serialization cost of one upload at the bytes
+    # the pipeline ships (uint8).  device_put returns once the transfer
+    # is enqueued; the wire time lands in the window's dispatch/drain
+    # slots.
     n_probes = 5
     probe = np.zeros((batch, 224, 224, 3), np.uint8)
     jax.block_until_ready(jax.device_put(probe))        # warm path
@@ -104,75 +242,31 @@ def _pipeline_bench(mx, mod, metric, staged_img_s, n_images=512, batch=256,
         samples.append(time.perf_counter() - t0)
     samples.sort()
     h2d_s = samples[n_probes // 2]
-    h2d_spread = (samples[0], samples[-1])
-    h2d_mbps = probe.nbytes / h2d_s / 1e6
 
-    # ResizeIter wraps epochs below the upload stage, so the staging
-    # worker never drains at an epoch boundary; size covers warmup +
-    # timed steps + staging lookahead
-    it = DeviceUploadIter(
-        ResizeIter(PrefetchingIter(make_iter()), size=steps + 8), depth=2)
+    it = PrefetchingIter(make_iter())
+    win = _timed_window(mod, metric, _cycling(it), steps, batch)
 
-    b = it.next()                       # warmup: same compiled program
-    mod.forward(b, is_train=True)
-    mod.update()
-    mod.update_metric(metric, b.label)
-    metric.get()
-    metric.reset()
-    # snapshot (don't zero: the live worker updates these concurrently)
-    base_stats = dict(it.stats())
-
-    in_s = disp_s = met_s = 0.0
-    t0 = time.perf_counter()
-    fresh = 0
-    for _ in range(steps):
-        t1 = time.perf_counter()
-        b = it.next()
-        t2 = time.perf_counter()
-        fresh += batch - (b.pad or 0)  # count only real (decoded) images
-        mod.forward(b, is_train=True)
-        mod.update()
-        t3 = time.perf_counter()
-        mod.update_metric(metric, b.label)
-        t4 = time.perf_counter()
-        in_s += t2 - t1
-        disp_s += t3 - t2
-        met_s += t4 - t3
-    metric.get()                       # completion barrier
-    elapsed = time.perf_counter() - t0
-    tail_s = elapsed - in_s - disp_s - met_s
-
-    img_s = fresh / elapsed
-    bound_img_s = min(decode_img_s, batch / h2d_s, staged_img_s or 1e9)
-    end_stats = it.stats()
-    upload = {k: (round(end_stats[k] - base_stats[k], 3)
-                  if isinstance(end_stats[k], float)
-                  else end_stats[k] - base_stats[k])
-              for k in ("upload_s", "source_s", "batches_staged")}
-    return {
-        "pipeline_img_per_sec": round(img_s, 2),
-        "pipeline_steps_timed": steps,
-        "pipeline_bound_img_per_sec": round(bound_img_s, 2),
-        "pipeline_vs_bound": round(img_s / bound_img_s, 3),
-        "decode_img_per_sec": round(decode_img_s, 1),
-        "h2d_s_per_batch": round(h2d_s, 3),
-        "h2d_probes": n_probes,
-        "h2d_s_spread": [round(h2d_spread[0], 3), round(h2d_spread[1], 3)],
-        # named, contiguous per-loop budget: sums to elapsed by
-        # construction (budget_coverage prints the check); input_wait is
-        # further attributed by the worker's upload_s / source_s split
-        "budget_input_wait_s_per_batch": round(in_s / steps, 3),
-        "budget_dispatch_s_per_batch": round(disp_s / steps, 3),
-        "budget_metric_s_per_batch": round(met_s / steps, 3),
-        "budget_tail_barrier_s_per_batch": round(tail_s / steps, 3),
-        "budget_coverage": round((in_s + disp_s + met_s + tail_s)
-                                 / elapsed, 3),
-        "upload_worker_upload_s": upload["upload_s"],
-        "upload_worker_source_s": upload["source_s"],
-        "upload_worker_batches": upload["batches_staged"],
-        "pipeline_host_h2d_mbps": round(h2d_mbps, 1),
-        "pipeline_host_cpu_cores": os.cpu_count(),
-    }
+    img_s = win.pop("img_per_sec")
+    # the bound's host-side costs (decode + upload serialization) share
+    # one core on this host, so they add; multi-core hosts overlap them.
+    # The WIRE rate is weather (measured 15-80 MB/s minutes apart) and
+    # is deliberately NOT in the bound: the gap between this bound and
+    # the measured rate IS the transport, visible in the dispatch/drain
+    # budget slots.
+    dec_s = batch / decode_img_s
+    host_s = dec_s + h2d_s if (os.cpu_count() or 1) == 1 \
+        else max(dec_s, h2d_s)
+    bound = min(batch / host_s, staged_img_s or 1e9)
+    out = {"img_per_sec": img_s,
+           "bound_img_per_sec": round(bound, 2),
+           "vs_bound": round(img_s / bound, 3),
+           "decode_img_per_sec": round(decode_img_s, 1),
+           "h2d_serialize_s_per_batch": round(h2d_s, 3),
+           "h2d_probes": n_probes,
+           "h2d_s_spread": [round(samples[0], 3), round(samples[-1], 3)],
+           "host_cpu_cores": os.cpu_count()}
+    out.update(win)
+    return out
 
 
 def main():
@@ -196,26 +290,22 @@ def main():
     # 2,493 img/s going 50 -> 150 steps on the same chip
     steps = 150 if on_tpu else 3
 
-    # channels-last: the TPU-native layout (lanes = channels keeps convs
-    # on the MXU without relayout transposes); ~6% over NCHW here.  The
-    # remaining ceiling is HBM bandwidth: tools/roofline.py measures this
-    # chip at ~181 TF/s bf16 / ~587 GB/s (ROOFLINE.json); XLA's cost
-    # analysis puts the step's byte traffic at the bandwidth roofline, so
-    # the step runs ~30% MFU — ResNet's low-arithmetic-intensity stages
-    # (stem, BN, early blocks) are bandwidth-bound, not MXU-bound.
-    sym = models.get_symbol("resnet-50", num_classes=1000, layout="NHWC")
-    ctx = mx.tpu() if on_tpu else mx.cpu()
-    mod = mx.mod.Module(context=ctx, symbol=sym, compute_dtype="bfloat16")
-    mod.bind(data_shapes=[("data", (batch, image, image, 3))],
-             label_shapes=[("softmax_label", (batch,))])
-    mod.init_params(mx.init.Xavier(rnd_type="gaussian", factor_type="in",
-                                   magnitude=2))
-    kv = mx.kvstore.create("dist_sync_tpu")
-    mod.init_optimizer(kvstore=kv, optimizer="sgd",
-                       optimizer_params={"learning_rate": 0.1,
-                                         "momentum": 0.9,
-                                         "rescale_grad": 1.0 / batch})
-    assert mod._trainer is not None, "bench must measure the fused path"
+    mod = _build_module(mx, models, batch, image,
+                        ctx=None if on_tpu else mx.cpu())
+
+    metric = mx.metric.create("acc")
+
+    # --- HBM-cached real-data pipeline (live transport mode: the
+    # trainer's init already issued the mode-flipping read)
+    pipe = None
+    pipe_err = None
+    if on_tpu:
+        try:
+            pipe = _cached_pipeline(mx, mod, metric)
+        except Exception as e:                      # noqa: BLE001
+            print("pipeline bench failed: %s" % e, file=sys.stderr)
+            pipe_err = str(e)
+    metric.reset()
 
     rng = np.random.RandomState(0)
     x = rng.normal(0, 1, (batch, image, image, 3)).astype(np.float32)
@@ -223,7 +313,6 @@ def main():
     # stage once in HBM (synthetic-data mode measures compute, not PCIe)
     data_batch = io.DataBatch(data=[mx.nd.array(x)],
                               label=[mx.nd.array(y)], pad=0)
-    metric = mx.metric.create("acc")
 
     # Module.fit inner loop (fwd+update+metric, device-side metric
     # accumulation), warmup covering compile + the one-time donated-
@@ -240,26 +329,16 @@ def main():
         "unit": "img/s",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
     }
-    # MFU vs the measured chip peak (tools/roofline.py artifact): step
-    # flops from XLA's own cost analysis over the same accounting that
-    # measured the peak
-    # --- end-to-end input pipeline (the reference's real-data-vs-
-    # --benchmark-1 parity contract, fit.py) ------------------------------
-    # Feed the same model through NativeImageRecordIter (C++ libjpeg
-    # thread-pool decode) + PrefetchingIter (engine double-buffering) over
-    # a synthetic RecordIO file.  On this driver host the pipeline is
-    # environment-bound, not framework-bound: ONE cpu core (JPEG decode
-    # ~400 img/s max) and ~10-40 MB/s host->device through the tunnel
-    # (tens of img/s at f32 224^2 batches; measured below and reported in
-    # the JSON line).  tests/test_io.py::test_prefetch_overlap proves the
-    # producer/consumer overlap property itself.
-    pipe = None
-    if on_tpu:
-        try:
-            pipe = _pipeline_bench(mx, mod, metric, img_s)
-        except Exception as e:                      # noqa: BLE001
-            print("pipeline bench failed: %s" % e, file=sys.stderr)
-            line["pipeline_error"] = str(e)
+    if pipe_err is not None:
+        line["pipeline_error"] = pipe_err
+    if pipe is not None:
+        # the cached pipeline's bound is the step itself: per-batch host
+        # work is one index upload, everything else is on-chip
+        bound = img_s
+        pipe["pipeline_bound_img_per_sec"] = round(bound, 2)
+        pipe["pipeline_vs_bound"] = round(
+            pipe["pipeline_img_per_sec"] / bound, 3)
+        line.update(pipe)
     try:
         from tools.stepcost import compile_step, cost_analysis
         roof = json.load(open(os.path.join(
@@ -298,8 +377,16 @@ def main():
     except Exception as e:                          # noqa: BLE001
         # never silently lose the MFU fields again (round-3 verdict #6)
         line["mfu_error"] = str(e)
-    if pipe is not None:
-        line.update(pipe)
+
+    # --- streaming pipeline (datasets beyond HBM), wire-paced
+    if on_tpu and os.environ.get("MXTPU_BENCH_STREAM_PROBE", "1") != "0":
+        try:
+            metric.reset()
+            for k, v in _stream_pipeline(mx, mod, metric, img_s).items():
+                line["stream_" + k] = v
+        except Exception as e:                      # noqa: BLE001
+            line["stream_error"] = str(e)
+
     print(json.dumps(line))
 
 

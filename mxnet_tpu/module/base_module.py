@@ -210,13 +210,20 @@ class BaseModule(object):
         batch's device upload overlaps the previous step's compute.
         Multi-host feeding stays synchronous
         (``make_array_from_process_local_data`` is a collective); opt
-        out with ``MXTPU_UPLOAD_OVERLAP=0``."""
+        out with ``MXTPU_UPLOAD_OVERLAP=0`` (or force on with ``=1``).
+        Defaults OFF on single-core hosts: there the decode pool, the
+        staging thread, and the transport's serializer fight for the
+        one core, and on the serialized tunnel transport a staging
+        thread cannot overlap the wire anyway (measured — perf.md
+        "Input pipeline")."""
         import os
         from ..io import DeviceUploadIter
         tr = getattr(self, "_trainer", None)
-        if (tr is None or tr.multihost
-                or isinstance(train_data, DeviceUploadIter)
-                or os.environ.get("MXTPU_UPLOAD_OVERLAP", "1") == "0"):
+        knob = os.environ.get("MXTPU_UPLOAD_OVERLAP", "")
+        enabled = knob == "1" or (knob != "0"
+                                  and (os.cpu_count() or 1) > 1)
+        if (tr is None or tr.multihost or not enabled
+                or isinstance(train_data, DeviceUploadIter)):
             return train_data
         data_sh = label_sh = None
         bs = tr._batch_shardings

@@ -163,18 +163,26 @@ def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_k):
-    """Blockwise recompute backward; all arrays [bh, t, d], lse [bh, t_qp]."""
+    """Blockwise recompute backward; all arrays [bh, t, d], lse [bh, t_qp].
+
+    The five einsums feed the MXU **in the input dtype** (bf16 for the
+    training path) with ``preferred_element_type=f32`` accumulation —
+    an f32 upcast first would run the MXU at a fraction of its bf16
+    rate and double the scan's HBM traffic.  The softmax recompute
+    (``exp``) and the ``ds`` combination stay in f32: they carry the
+    numerics; the matmul inputs don't (same contract as the forward
+    kernel's bf16-in/f32-accum design)."""
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
     f32 = jnp.float32
-    qs = q.astype(f32) * scale
-    do32 = do.astype(f32)
-    o32 = o.astype(f32)
+    mxu = q.dtype if q.dtype in (jnp.bfloat16, jnp.float16) else f32
+    qs = (q.astype(f32) * scale).astype(mxu)   # scale applied in f32
+    do_m = do.astype(mxu)
+    delta = jnp.sum(do.astype(f32) * o.astype(f32), axis=-1)  # [bh, t_q]
     lse = lse[:, :t_q]
-    delta = jnp.sum(do32 * o32, axis=-1)  # [bh, t_q]
 
-    kp = _pad_time(k.astype(f32), block_k)
-    vp = _pad_time(v.astype(f32), block_k)
+    kp = _pad_time(k.astype(mxu), block_k)
+    vp = _pad_time(v.astype(mxu), block_k)
     t_kvp = kp.shape[1]
     n_kb = t_kvp // block_k
     kb_arr = kp.reshape(bh, n_kb, block_k, d).transpose(1, 0, 2, 3)
@@ -184,7 +192,8 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_k):
 
     def body(dq, xs):
         kb_idx, kblk, vblk = xs
-        s = jnp.einsum("btd,bkd->btk", qs, kblk)
+        s = jnp.einsum("btd,bkd->btk", qs, kblk,
+                       preferred_element_type=f32)
         k_pos = kb_idx * block_k + jnp.arange(block_k)
         mask = k_pos[None, :] < t_kv
         if causal:
@@ -192,11 +201,16 @@ def _bwd_impl(q, k, v, o, lse, do, causal, scale, block_k):
         s = jnp.where(mask[None], s, _NEG_INF)
         # exp(-inf - lse) -> 0 even when lse == -inf thanks to the where
         p = jnp.where(mask[None], jnp.exp(s - lse[..., None]), 0.0)
-        dv_blk = jnp.einsum("btk,btd->bkd", p, do32)
-        dp = jnp.einsum("btd,bkd->btk", do32, vblk)
-        ds = p * (dp - delta[..., None])
-        dq = dq + jnp.einsum("btk,bkd->btd", ds, kblk) * scale
-        dk_blk = jnp.einsum("btk,btd->bkd", ds, qs)
+        p_m = p.astype(mxu)
+        dv_blk = jnp.einsum("btk,btd->bkd", p_m, do_m,
+                            preferred_element_type=f32)
+        dp = jnp.einsum("btd,bkd->btk", do_m, vblk,
+                        preferred_element_type=f32)
+        ds = (p * (dp - delta[..., None])).astype(mxu)
+        dq = dq + jnp.einsum("btk,bkd->btd", ds, kblk,
+                             preferred_element_type=f32) * scale
+        dk_blk = jnp.einsum("btk,btd->bkd", ds, qs,
+                            preferred_element_type=f32)
         return dq, (dk_blk, dv_blk)
 
     dq0 = jnp.zeros((bh, t_q, d), f32)

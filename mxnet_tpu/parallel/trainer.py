@@ -160,24 +160,31 @@ class Trainer:
             return
         initializer = initializer or _init_mod.Uniform(0.01)
         attrs = self.symbol.attr_dict()
-        params = {}
-        for n in self.param_names:
-            shape = self._arg_shapes[n]
+
+        def _seed(n, shape, given):
+            if given is not None and n in given:
+                # NEVER round-trip a device-resident mirror through the
+                # host: on the tunneled-chip transport a single
+                # device->host read permanently switches the link out of
+                # its async fast path (~30x slower uploads for the rest
+                # of the process — docs/how_to/perf.md "host reads").
+                # Adopt via an on-device COPY (jnp.copy): the step fn
+                # donates params, so aliasing the caller's buffer would
+                # delete it after the first step; only true host arrays
+                # pay an upload.
+                src = given[n]
+                return jnp.copy(src.data) if isinstance(src, NDArray) \
+                    else jnp.asarray(np.asarray(src))
             arr = NDArray(jnp.zeros(shape, jnp.float32))
-            if arg_params and n in arg_params:
-                arr._set_data(jnp.asarray(arg_params[n].asnumpy()))
-            else:
-                initializer(InitDesc(n, attrs.get(n, {})), arr)
-            params[n] = self._place(arr.data, self._param_sharding(n))
-        aux = {}
-        for n in self.aux_names:
-            shape = self._aux_shapes[n]
-            arr = NDArray(jnp.zeros(shape, jnp.float32))
-            if aux_params and n in aux_params:
-                arr._set_data(jnp.asarray(aux_params[n].asnumpy()))
-            else:
-                initializer(InitDesc(n, attrs.get(n, {})), arr)
-            aux[n] = self._place(arr.data, self._param_sharding(n))
+            initializer(InitDesc(n, attrs.get(n, {})), arr)
+            return arr.data
+
+        params = {n: self._place(_seed(n, self._arg_shapes[n], arg_params),
+                                 self._param_sharding(n))
+                  for n in self.param_names}
+        aux = {n: self._place(_seed(n, self._aux_shapes[n], aux_params),
+                              self._param_sharding(n))
+               for n in self.aux_names}
         self.params, self.aux = params, aux
         init_fn, self._update_fn = make_update_fn(
             self.optimizer, self.param_names)
@@ -429,13 +436,19 @@ class Trainer:
         return arg, aux
 
     def set_params(self, arg_params, aux_params=None):
+        def _val(v):
+            # device-resident values: no host round-trip (each asnumpy
+            # is a full pipeline drain on the tunnel transport), but DO
+            # copy on device — the donated step fn would otherwise
+            # delete the caller's buffer after the next step
+            raw = v.data if isinstance(v, NDArray) else np.asarray(v)
+            return jnp.copy(jnp.asarray(raw, dtype=jnp.float32))
+
         for n, v in (arg_params or {}).items():
             if n in self.params:
-                self.params[n] = self._place(
-                    jnp.asarray(v.asnumpy(), dtype=jnp.float32),
-                    self._param_sharding(n))
+                self.params[n] = self._place(_val(v),
+                                             self._param_sharding(n))
         for n, v in (aux_params or {}).items():
             if n in self.aux:
-                self.aux[n] = self._place(
-                    jnp.asarray(v.asnumpy(), dtype=jnp.float32),
-                    self._param_sharding(n))
+                self.aux[n] = self._place(_val(v),
+                                          self._param_sharding(n))

@@ -549,6 +549,7 @@ def test_fit_wraps_upload_overlap():
                                 name="fc")
     net = mx.sym.SoftmaxOutput(net, name="softmax")
     os.environ["MXTPU_MODULE_FUSED"] = "always"
+    os.environ["MXTPU_UPLOAD_OVERLAP"] = "1"   # force on (1-core CI host)
     try:
         mod = mx.mod.Module(net, context=mx.cpu())
         wrapped = {}
@@ -567,5 +568,92 @@ def test_fit_wraps_upload_overlap():
             bm.BaseModule._maybe_overlap_uploads = orig
         assert wrapped["did"]
         assert not wrapped["iter"]._worker.is_alive()   # torn down
+    finally:
+        os.environ.pop("MXTPU_MODULE_FUSED", None)
+        os.environ.pop("MXTPU_UPLOAD_OVERLAP", None)
+
+
+class _FrameSource(io.DataIter):
+    """Deterministic uint8 frames for DeviceCacheIter tests."""
+
+    N, H, W = 20, 10, 12
+    frames = np.arange(N * H * W * 3, dtype=np.uint8).reshape(N, H, W, 3)
+    labels = np.arange(N, dtype=np.float32)
+
+    def __init__(self):
+        super().__init__(8)
+        self.i = 0
+        self.provide_data = [io.DataDesc("data", (8, self.H, self.W, 3),
+                                         np.uint8)]
+        self.provide_label = [io.DataDesc("softmax_label", (8,))]
+
+    def next(self):
+        if self.i >= self.N:
+            raise StopIteration
+        lo = self.i
+        hi = min(self.N, lo + 8)
+        self.i = hi
+        sel = np.arange(lo, lo + 8) % self.N
+        return io.DataBatch([self.frames[sel]], [self.labels[sel]],
+                            pad=8 - (hi - lo))
+
+    def reset(self):
+        self.i = 0
+
+
+def test_device_cache_iter_center_crop():
+    """The cache reproduces the source rows exactly under a center crop
+    (one upload at build, per-batch work all on device)."""
+    src = _FrameSource()
+    it = io.DeviceCacheIter(src, data_shape=(6, 8))
+    assert it.num_data == src.N
+    bs = list(it)
+    assert len(bs) == 3 and bs[-1].pad == 4
+    got = np.concatenate([b.data[0].asnumpy() for b in bs], 0)
+    y0, x0 = (src.H - 6) // 2, (src.W - 8) // 2
+    want = src.frames[np.arange(24) % src.N][:, y0:y0 + 6, x0:x0 + 8, :]
+    np.testing.assert_array_equal(got, want)
+    lbl = np.concatenate([b.label[0].asnumpy() for b in bs])
+    np.testing.assert_array_equal(lbl, src.labels[np.arange(24) % src.N])
+    it.reset()
+    assert sum(1 for _ in it) == 3
+
+
+def test_device_cache_iter_random_aug_provenance():
+    """Every random crop/mirror emitted is literally a window of its
+    labeled source frame, and epochs differ under shuffle."""
+    src = _FrameSource()
+    it = io.DeviceCacheIter(src, data_shape=(6, 8), rand_crop=True,
+                            rand_mirror=True, shuffle=True, seed=3)
+    b = it.next()
+    for img, lab in zip(b.data[0].asnumpy(),
+                        b.label[0].asnumpy().astype(int)):
+        frame = src.frames[lab]
+        windows = []
+        for cand in (frame, frame[:, ::-1, :]):
+            windows += [cand[y:y + 6, x:x + 8]
+                        for y in range(src.H - 6 + 1)
+                        for x in range(src.W - 8 + 1)]
+        assert any(np.array_equal(img, w) for w in windows)
+    a1 = it.next().data[0].asnumpy()
+    it.reset()
+    it.next()
+    a2 = it.next().data[0].asnumpy()
+    assert not np.array_equal(a1, a2)
+
+
+def test_device_cache_iter_feeds_fit():
+    net = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=4,
+                             kernel=(3, 3), layout="NHWC", name="c")
+    net = mx.sym.Flatten(mx.sym.Activation(net, act_type="relu"))
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    os.environ["MXTPU_MODULE_FUSED"] = "always"
+    try:
+        mod = mx.mod.Module(net, context=mx.cpu())
+        it = io.DeviceCacheIter(_FrameSource(), data_shape=(6, 8),
+                                rand_crop=True)
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                initializer=mx.init.Xavier())
     finally:
         os.environ.pop("MXTPU_MODULE_FUSED", None)

@@ -683,3 +683,91 @@ def test_lowp_report(capsys):
     missing_core = {n for n in F16_CORE
                     if n in {c["op"] for c in CASES}} - f16
     assert not missing_core, missing_core
+
+
+# ----------------------------------------------------------------------
+# Reduced-precision BACKWARD tier: the fused trainer computes gradients
+# in bf16 (Trainer compute_dtype), so the flagship-core ops' bf16
+# backward must track their own f32 backward within representation
+# tolerance — the gradient half of the reference's check_consistency
+# dtype crossing (test_utils.py:676-760), which this sweep previously
+# exercised forward-only.
+
+def _bwd_eligible(c):
+    if c["kind"] != "grad" or c["op"] not in F16_CORE:
+        return False
+    if c["op"] in LOWP_SKIP:
+        return False
+    try:
+        if _registry.get(c["op"]).uses_rng:
+            return False      # the two executors would draw new keys
+    except Exception:
+        return False
+    return True
+
+
+_BWD_OPS_SEEN = set()
+_BWD_CASES = []
+for _c in CASES:
+    if _bwd_eligible(_c) and _c["op"] not in _BWD_OPS_SEEN:
+        _BWD_OPS_SEEN.add(_c["op"])
+        _BWD_CASES.append(_c)
+
+
+def _grads_in_dtype(case, dtype):
+    """Bind in ``dtype``, run fwd(train)+bwd with all-ones head
+    gradients, return the f32 view of every requested input grad."""
+    sym, aux = _build_symbol(case)
+
+    def cast(v):
+        v = np.asarray(v, "f")
+        arr = mx.nd.array(v)
+        if dtype != "float32" and not np.all(v == np.round(v)):
+            return arr.astype(dtype)
+        return arr
+
+    args = {k: cast(v) for k, v in case["loc"].items()}
+    targets = list(case["grad_nodes"] or case["loc"])
+    grads = {k: mx.nd.zeros(np.asarray(case["loc"][k]).shape,
+                            dtype=args[k].dtype) for k in targets}
+    auxs = {k: cast(v) for k, v in (aux or {}).items()} or None
+    exe = sym.bind(mx.current_context(), args=args, args_grad=grads,
+                   aux_states=auxs)
+    exe.forward(is_train=True)
+    # deterministic NON-uniform head gradients: a constant cotangent is
+    # degenerate for normalizing ops (softmax/BN jacobians annihilate
+    # it, leaving only rounding noise to compare)
+    hg = np.random.RandomState(11)
+    exe.backward([mx.nd.array(
+        hg.normal(0, 1, o.shape).astype("f")).astype(o.dtype)
+        for o in exe.outputs])
+    return {k: grads[k].asnumpy().astype(np.float32) for k in targets}
+
+
+@pytest.mark.parametrize("case", _BWD_CASES,
+                         ids=[c["id"] + "::bf16bwd" for c in _BWD_CASES])
+def test_op_lowp_backward(case):
+    """bf16 input gradients track the op's own f32 gradients within
+    bf16 representation tolerance (~2^-8, headroom for accumulation)."""
+    ref = _grads_in_dtype(case, "float32")
+    low = _grads_in_dtype(case, "bfloat16")
+    for k in ref:
+        scale = max(float(np.abs(ref[k]).max()), 1e-2)
+        np.testing.assert_allclose(
+            low[k], ref[k], rtol=0.08, atol=0.08 * scale,
+            err_msg="%s: bf16 backward diverges for input %r"
+                    % (case["id"], k))
+
+
+def test_lowp_backward_report(capsys):
+    ops = {c["op"] for c in _BWD_CASES}
+    with capsys.disabled():
+        print("\nLOW-PRECISION BACKWARD SWEEP: %d flagship-core ops "
+              "bf16-gradient-checked against f32" % len(ops))
+    core_with_grad_cases = {c["op"] for c in CASES
+                            if c["kind"] == "grad"} & F16_CORE
+    missing = {o for o in core_with_grad_cases
+               if o not in ops and o not in LOWP_SKIP
+               and not _registry.get(o).uses_rng}
+    assert not missing, "core ops missing bf16 bwd coverage: %s" % missing
+    assert len(ops) >= 25, len(ops)

@@ -33,18 +33,29 @@ def timed_module_steps(mod, metric, data_batch, steps, warmup=5):
     return (seconds_for_timed_steps, warmup_seconds).  ``metric.get()``
     drains the device accumulator, which depends on every step's
     outputs — the honest completion barrier on backends where
-    ``block_until_ready`` does not block (see bench.py)."""
+    ``block_until_ready`` does not block (see bench.py).
+
+    The warmup runs as TWO drain-closed cycles: the tunnel transport
+    dispatches by value for the first two execute+drain cycles of a
+    process and by reference (~20x faster) from the third, so a single
+    warmup cycle would leave the timed window in the slow regime
+    (docs/how_to/perf.md "host reads")."""
     def one_step():
         mod.forward(data_batch, is_train=True)
         mod.update()
         mod.update_metric(metric, data_batch.label)
 
     t0 = time.perf_counter()
-    for _ in range(warmup):
-        one_step()
-    metric.get()
+    if warmup >= 2:
+        cycles = (warmup // 2, warmup - warmup // 2)
+    else:
+        cycles = (warmup,) if warmup else ()   # warmup=0 stays cold
+    for n in cycles:
+        for _ in range(n):
+            one_step()
+        metric.get()
+        metric.reset()
     warm_s = time.perf_counter() - t0
-    metric.reset()
 
     t0 = time.perf_counter()
     for _ in range(steps):
