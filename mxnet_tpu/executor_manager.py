@@ -18,18 +18,17 @@ from . import ndarray as nd
 
 def _split_input_slice(batch_size, work_load_list):
     """Split a batch into slices proportional to work_load_list
-    (reference ``executor_manager.py:15-41``)."""
-    total_work_load = sum(work_load_list)
-    batch_num_list = [round(work_load * batch_size / total_work_load)
-                      for work_load in work_load_list]
-    batch_num_sum = sum(batch_num_list)
-    if batch_num_sum < batch_size:
-        batch_num_list[-1] += batch_size - batch_num_sum
+    (reference contract ``executor_manager.py:15-41``)."""
+    total = sum(work_load_list)
+    shares = [round(batch_size * w / total) for w in work_load_list]
+    shortfall = batch_size - sum(shares)
+    if shortfall > 0:
+        shares[-1] += shortfall     # rounding remainder goes last
     slices = []
     end = 0
-    for batch_num in batch_num_list:
+    for share in shares:
         begin = int(min(end, batch_size))
-        end = int(min(begin + batch_num, batch_size))
+        end = int(min(begin + share, batch_size))
         if begin >= end:
             raise MXNetError("Too many slices. Some splits are empty.")
         slices.append(slice(begin, end))

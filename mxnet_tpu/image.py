@@ -111,13 +111,16 @@ def imresize(src, w, h, interp=2):
 
 def scale_down(src_size, size):
     """Scale ``size`` down to fit in ``src_size``, keeping aspect ratio
-    (``image.py:45-53``)."""
+    (contract of ``image.py:45-53``)."""
     w, h = size
     sw, sh = src_size
+    # shrink each overflowing edge in turn, dragging the other with it
     if sh < h:
-        w, h = float(w * sh) / h, sh
+        w = float(w * sh) / h
+        h = sh
     if sw < w:
-        w, h = sw, float(h * sw) / w
+        h = float(h * sw) / w
+        w = sw
     return int(w), int(h)
 
 
@@ -202,34 +205,28 @@ def random_size_crop(src, size, min_area, ratio, interp=2):
 
 def ResizeAug(size, interp=2):
     """Short-edge resize augmenter."""
-    def aug(src):
-        return [resize_short(src, size, interp)]
-    return aug
+    return lambda src: [resize_short(src, size, interp)]
 
 
 def ForceResizeAug(size, interp=2):
     """Exact-size resize augmenter (ignores aspect ratio)."""
-    def aug(src):
-        return [imresize(src, size[0], size[1], interp)]
-    return aug
+    return lambda src: [imresize(src, size[0], size[1], interp)]
 
 
 def RandomCropAug(size, interp=2):
-    def aug(src):
-        return [random_crop(src, size, interp)[0]]
-    return aug
+    """Random-position crop augmenter."""
+    return lambda src: [random_crop(src, size, interp)[0]]
 
 
 def RandomSizedCropAug(size, min_area, ratio, interp=2):
-    def aug(src):
-        return [random_size_crop(src, size, min_area, ratio, interp)[0]]
-    return aug
+    """Random area/aspect crop augmenter (inception-style)."""
+    return lambda src: [random_size_crop(src, size, min_area, ratio,
+                                         interp)[0]]
 
 
 def CenterCropAug(size, interp=2):
-    def aug(src):
-        return [center_crop(src, size, interp)[0]]
-    return aug
+    """Center crop augmenter."""
+    return lambda src: [center_crop(src, size, interp)[0]]
 
 
 def RandomOrderAug(ts):

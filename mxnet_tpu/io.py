@@ -39,14 +39,14 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
     """Name/shape/dtype/layout descriptor (reference ``io.py:19-79``)."""
 
     def __new__(cls, name, shape, dtype=mx_real_t, layout="NCHW"):
-        ret = super().__new__(cls, name, shape)
-        ret.dtype = dtype
-        ret.layout = layout
-        return ret
+        desc = super().__new__(cls, name, shape)
+        desc.dtype = dtype
+        desc.layout = layout
+        return desc
 
     def __repr__(self):
-        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape, self.dtype,
-                                          self.layout)
+        return "DataDesc[%s,%s,%s,%s]" % (self.name, self.shape,
+                                          self.dtype, self.layout)
 
     @staticmethod
     def get_batch_axis(layout):
@@ -77,14 +77,15 @@ class DataBatch(object):
         self.provide_label = provide_label
 
     def __str__(self):
-        data_shapes = [d.shape for d in self.data]
-        label_shapes = [l.shape for l in self.label] if self.label else []
-        return "{}: data shapes: {} label shapes: {}".format(
-            self.__class__.__name__, data_shapes, label_shapes)
+        return "%s: data shapes: %s label shapes: %s" % (
+            type(self).__name__, [d.shape for d in self.data],
+            [l.shape for l in self.label] if self.label else [])
 
 
 class DataIter(object):
     """Base iterator (reference ``io.py:126-213``)."""
+
+    batch_size = 0
 
     def __init__(self, batch_size=0):
         self.batch_size = batch_size
@@ -92,17 +93,17 @@ class DataIter(object):
     def __iter__(self):
         return self
 
+    def __next__(self):
+        return self.next()
+
     def reset(self):
         pass
 
     def next(self):
-        if self.iter_next():
-            return DataBatch(data=self.getdata(), label=self.getlabel(),
-                             pad=self.getpad(), index=self.getindex())
-        raise StopIteration
-
-    def __next__(self):
-        return self.next()
+        if not self.iter_next():
+            raise StopIteration
+        return DataBatch(data=self.getdata(), label=self.getlabel(),
+                         pad=self.getpad(), index=self.getindex())
 
     def iter_next(self):
         pass
@@ -120,7 +121,26 @@ class DataIter(object):
         pass
 
 
-class ResizeIter(DataIter):
+class _CurrentBatchAccessors(object):
+    """The legacy DataIter getter protocol over ``self.current_batch``
+    (shared by every wrapper iterator that stages whole batches)."""
+
+    current_batch = None
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class ResizeIter(_CurrentBatchAccessors, DataIter):
     """Clamp (or stretch) another iterator to exactly ``size`` batches
     per epoch, wrapping the inner iterator's epochs as needed
     (reference contract ``io.py:216-278``)."""
@@ -155,20 +175,8 @@ class ResizeIter(DataIter):
                 self.data_iter.reset()
         raise MXNetError("inner iterator yields no batches")
 
-    def getdata(self):
-        return self.current_batch.data
 
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
-
-
-class PrefetchingIter(DataIter):
+class PrefetchingIter(_CurrentBatchAccessors, DataIter):
     """Double-buffering prefetcher over one or more iterators
     (reference ``io.py:281-423``; C++ analog ``iter_prefetcher.h``).
 
@@ -244,23 +252,30 @@ class PrefetchingIter(DataIter):
         except Exception:
             pass
 
+    @staticmethod
+    def _renamed(rename_maps, per_iter_descs):
+        """Flatten descriptors over wrapped iterators, applying the
+        optional per-iterator name remapping."""
+        if rename_maps is None:
+            return [d for descs in per_iter_descs for d in descs]
+        out = []
+        for names, descs in zip(rename_maps, per_iter_descs):
+            for d in descs:
+                # only full descriptors participate in renaming; plain
+                # (name, shape) tuples pass through untouched
+                out.append(DataDesc(names[d.name], d.shape, d.dtype)
+                           if isinstance(d, DataDesc) else DataDesc(*d))
+        return out
+
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        return self._renamed(self.rename_data,
+                             [i.provide_data for i in self.iters])
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        return self._renamed(self.rename_label,
+                             [i.provide_label for i in self.iters])
 
     def reset(self):
         self._drain()
@@ -281,11 +296,11 @@ class PrefetchingIter(DataIter):
         for batch in self.next_batch:
             assert batch.pad == self.next_batch[0].pad, \
                 "Different pad number in the data batches"
+        lead = self.next_batch[0]
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad,
-            self.next_batch[0].index,
+            [a for b in self.next_batch for a in b.data],
+            [a for b in self.next_batch for a in b.label],
+            lead.pad, lead.index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
         for i in range(self.n_iter):
@@ -297,20 +312,8 @@ class PrefetchingIter(DataIter):
             return self.current_batch
         raise StopIteration
 
-    def getdata(self):
-        return self.current_batch.data
 
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
-
-
-class DeviceUploadIter(DataIter):
+class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
     """Stages each batch on the accelerator AHEAD of consumption.
 
     ``PrefetchingIter`` overlaps host decode with device compute; this is
@@ -463,18 +466,6 @@ class DeviceUploadIter(DataIter):
         except StopIteration:
             return False
 
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getindex(self):
-        return self.current_batch.index
-
-    def getpad(self):
-        return self.current_batch.pad
-
     def stats(self):
         """Worker-side wall attribution: ``upload_s`` (device_put +
         readiness wait) vs ``source_s`` (inner-iterator wait)."""
@@ -483,7 +474,7 @@ class DeviceUploadIter(DataIter):
                 "batches_staged": self.batches_staged}
 
 
-class DeviceCacheIter(DataIter):
+class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
     """Device-resident dataset cache: decode + upload the WHOLE dataset
     once, then run the per-batch pipeline — gather, random crop, random
     mirror — on the accelerator.  Per-batch host->device traffic drops
@@ -623,15 +614,6 @@ class DeviceCacheIter(DataIter):
             provide_label=self.provide_label)
         return self.current_batch
 
-    def getdata(self):
-        return self.current_batch.data
-
-    def getlabel(self):
-        return self.current_batch.label
-
-    def getpad(self):
-        return self.current_batch.pad
-
 
 def _init_data(data, allow_empty, default_name):
     """Normalize data into a list of (name, numpy) pairs
@@ -697,34 +679,35 @@ class NDArrayIter(DataIter):
         self.cursor = -batch_size
         self.last_batch_handle = last_batch_handle
 
+    def _batch_descs(self, pairs):
+        """Per-source descriptors with the batch dim swapped in."""
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         v.dtype) for k, v in pairs]
+
     @property
     def provide_data(self):
-        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
-                         v.dtype)
-                for k, v in self.data]
+        return self._batch_descs(self.data)
 
     @property
     def provide_label(self):
-        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
-                         v.dtype)
-                for k, v in self.label]
+        return self._batch_descs(self.label)
 
     def hard_reset(self):
         self.cursor = -self.batch_size
 
     def reset(self):
+        # roll_over carries the unconsumed tail rows into the next
+        # epoch: start the cursor early by exactly that remainder
+        leftover = 0
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:
-            # carry the unconsumed tail rows into the next epoch: start
-            # the cursor early by exactly that remainder
             leftover = (self.cursor % self.num_data) % self.batch_size
-            self.cursor = leftover - self.batch_size
-        else:
-            self.cursor = -self.batch_size
+        self.cursor = leftover - self.batch_size
 
     def iter_next(self):
-        self.cursor += self.batch_size
-        return self.cursor < self.num_data
+        nxt = self.cursor + self.batch_size
+        self.cursor = nxt
+        return nxt < self.num_data
 
     def next(self):
         if self.iter_next():

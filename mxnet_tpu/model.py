@@ -273,16 +273,15 @@ class FeedForward(object):
         return res[0][1]
 
     def save(self, prefix, epoch=None):
-        if epoch is None:
-            epoch = self.num_epoch
-        save_checkpoint(prefix, epoch, self.symbol, self.arg_params,
-                        self.aux_params)
+        save_checkpoint(prefix,
+                        self.num_epoch if epoch is None else epoch,
+                        self.symbol, self.arg_params, self.aux_params)
 
     @staticmethod
     def load(prefix, epoch, ctx=None, **kwargs):
-        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
-        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
-                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+        symbol, args, auxs = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=args,
+                           aux_params=auxs, begin_epoch=epoch, **kwargs)
 
     @staticmethod
     def create(symbol, X, y=None, ctx=None, num_epoch=None, epoch_size=None,
@@ -291,9 +290,9 @@ class FeedForward(object):
                batch_end_callback=None, kvstore="local", logger=None,
                work_load_list=None, eval_end_callback=None,
                eval_batch_end_callback=None, **kwargs):
-        model = FeedForward(symbol, ctx=ctx, num_epoch=num_epoch,
-                            epoch_size=epoch_size, optimizer=optimizer,
-                            initializer=initializer, **kwargs)
+        kwargs.update(num_epoch=num_epoch, epoch_size=epoch_size,
+                      optimizer=optimizer, initializer=initializer)
+        model = FeedForward(symbol, ctx=ctx, **kwargs)
         model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
                   epoch_end_callback=epoch_end_callback,
                   batch_end_callback=batch_end_callback, kvstore=kvstore,

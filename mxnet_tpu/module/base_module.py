@@ -56,10 +56,9 @@ class BaseModule(object):
 
     def __init__(self, logger=logging):
         self.logger = logger
-        self.binded = False
-        self.for_training = False
-        self.inputs_need_grad = False
-        self.params_initialized = False
+        # lifecycle flags, flipped by bind/init_params/init_optimizer
+        self.binded = self.params_initialized = False
+        self.for_training = self.inputs_need_grad = False
         self.optimizer_initialized = False
         self._symbol = None
         self._total_exec_bytes = 0
@@ -154,14 +153,13 @@ class BaseModule(object):
             from ..initializer import Uniform
             initializer = Uniform(0.01)
 
-        self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label,
+        self.bind(train_data.provide_data, train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
-        self.init_params(initializer=initializer, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        self.init_params(initializer=initializer, force_init=force_init,
+                         allow_missing=allow_missing,
+                         arg_params=arg_params, aux_params=aux_params)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
@@ -268,9 +266,9 @@ class BaseModule(object):
 
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
-        self.init_params(initializer=None, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init)
+        self.init_params(initializer=None, force_init=force_init,
+                         allow_missing=allow_missing,
+                         arg_params=arg_params, aux_params=aux_params)
 
     def save_params(self, fname):
         """Write params with the reference's ``arg:``/``aux:`` key
@@ -296,19 +294,19 @@ class BaseModule(object):
     def forward(self, data_batch, is_train=None):
         raise NotImplementedError()
 
-    def backward(self, out_grads=None):
+    def get_outputs(self, merge_multi_context=True):
         raise NotImplementedError()
 
-    def get_outputs(self, merge_multi_context=True):
+    def backward(self, out_grads=None):
         raise NotImplementedError()
 
     def get_input_grads(self, merge_multi_context=True):
         raise NotImplementedError()
 
-    def update(self):
+    def update_metric(self, eval_metric, labels):
         raise NotImplementedError()
 
-    def update_metric(self, eval_metric, labels):
+    def update(self):
         raise NotImplementedError()
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -322,26 +320,15 @@ class BaseModule(object):
         raise NotImplementedError()
 
     # ==================================================================
-    # introspection
-    @property
-    def data_names(self):
+    # introspection (all subclass responsibility)
+    def _abstract_property(self):
         raise NotImplementedError()
 
-    @property
-    def output_names(self):
-        raise NotImplementedError()
-
-    @property
-    def data_shapes(self):
-        raise NotImplementedError()
-
-    @property
-    def label_shapes(self):
-        raise NotImplementedError()
-
-    @property
-    def output_shapes(self):
-        raise NotImplementedError()
+    data_names = property(_abstract_property)
+    output_names = property(_abstract_property)
+    data_shapes = property(_abstract_property)
+    label_shapes = property(_abstract_property)
+    output_shapes = property(_abstract_property)
 
     def install_monitor(self, mon):
         raise NotImplementedError()

@@ -93,16 +93,16 @@ class DataParallelExecutorGroup(object):
                       for x in data_shapes]
         for (name, shape), axis in zip(data_shapes, major_axis):
             if axis == -1:
-                continue
-            batch_size = shape[axis]
-            if self.batch_size is not None:
-                assert batch_size == self.batch_size, \
+                continue      # no batch dimension in this layout
+            found = shape[axis]
+            if self.batch_size is None:
+                self.batch_size = found
+                self.slices = _split_input_slice(found, self.workload)
+            else:
+                assert found == self.batch_size, \
                     ("all data must have the same batch size: "
                      + ("batch_size = %d, but " % self.batch_size)
                      + ("%s has shape %s" % (name, shape)))
-            else:
-                self.batch_size = batch_size
-                self.slices = _split_input_slice(self.batch_size, self.workload)
         return major_axis
 
     def bind_exec(self, data_shapes, label_shapes, shared_group=None,
@@ -241,11 +241,12 @@ class DataParallelExecutorGroup(object):
         return [0] * len(self.symbol.list_outputs())
 
     def get_outputs(self, merge_multi_context=True):
-        outputs = [[exec_.outputs[i] for exec_ in self.execs]
-                   for i in range(len(self.execs[0].outputs))]
-        if merge_multi_context:
-            outputs = _merge_multi_context(outputs, self.output_layouts)
-        return outputs
+        n_out = len(self.execs[0].outputs)
+        per_output = [[e.outputs[i] for e in self.execs]
+                      for i in range(n_out)]
+        if not merge_multi_context:
+            return per_output
+        return _merge_multi_context(per_output, self.output_layouts)
 
     def get_input_grads(self, merge_multi_context=True):
         assert self.inputs_need_grad

@@ -67,9 +67,10 @@ class Module(BaseModule):
         # one XLA computation), "always" fuses any single context (used
         # by the CPU tests), "never" forces the classic executor group
         self._fused_mode = os.environ.get("MXTPU_MODULE_FUSED", "auto")
+        n_dev = len(self._context)
         if work_load_list is None:
-            work_load_list = [1] * len(self._context)
-        assert len(work_load_list) == len(self._context)
+            work_load_list = [1] * n_dev
+        assert len(work_load_list) == n_dev
         self._work_load_list = work_load_list
 
         self._symbol = symbol
@@ -111,8 +112,7 @@ class Module(BaseModule):
         """Create a Module from a checkpoint (reference ``module.py:104``)."""
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
@@ -142,16 +142,16 @@ class Module(BaseModule):
             self._auto_fused = False
 
     @property
+    def output_names(self):
+        return self._output_names
+
+    @property
     def data_names(self):
         return self._data_names
 
     @property
     def label_names(self):
         return self._label_names
-
-    @property
-    def output_names(self):
-        return self._output_names
 
     @property
     def data_shapes(self):
@@ -176,9 +176,9 @@ class Module(BaseModule):
     # ------------------------------------------------------------------
     def get_params(self):
         assert self.binded and self.params_initialized
-        if self._params_dirty:
+        if self._params_dirty:      # trained values still on device
             self._sync_params_from_devices()
-        return (self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False):
@@ -189,27 +189,28 @@ class Module(BaseModule):
             return
         assert self.binded, "call bind before initializing the parameters"
 
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError("%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(name, arr)
-            else:
-                initializer(name, arr)
+        def _seed_one(desc, arr, given):
+            # a caller-supplied dict wins; absent entries fall back to
+            # the initializer only when allow_missing permits
+            if given is None:
+                initializer(desc, arr)
+                return
+            src = given.get(desc)
+            if src is not None:
+                if src is not arr:
+                    src.copyto(arr)
+                return
+            if not allow_missing:
+                raise RuntimeError("%s is not presented" % desc)
+            if initializer is not None:
+                initializer(desc, arr)
 
         attrs = self._symbol.attr_dict()
-        for name, arr in sorted(self._arg_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, aux_params)
+        for params, given in ((self._arg_params, arg_params),
+                              (self._aux_params, aux_params)):
+            for name, arr in sorted(params.items()):
+                _seed_one(InitDesc(name, attrs.get(name, None)), arr,
+                          given)
 
         self.params_initialized = True
         self._params_dirty = False
@@ -225,9 +226,11 @@ class Module(BaseModule):
     def set_params(self, arg_params, aux_params, allow_missing=False,
                    force_init=True):
         if not allow_missing:
-            self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params, allow_missing=allow_missing,
-                             force_init=force_init)
+            # complete assignment routes through init_params so the
+            # trainer/executor mirrors stay coherent
+            self.init_params(initializer=None, force_init=force_init,
+                             allow_missing=allow_missing,
+                             arg_params=arg_params, aux_params=aux_params)
             return
         if self.params_initialized and not force_init:
             warnings.warn("Parameters already initialized and force_init=False. "
@@ -291,9 +294,11 @@ class Module(BaseModule):
 
         self._bind_exec_group(shared_group=shared_group, grad_req=grad_req)
         if shared_module is not None:
-            self.params_initialized = True
+            # adopt the host mirrors wholesale: shared modules train one
+            # parameter set
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
+            self.params_initialized = True
         elif self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
         else:
@@ -485,10 +490,7 @@ class Module(BaseModule):
                                           force_init=True)
                 self._kvstore = None
                 self._update_on_kvstore = False
-                self.optimizer_initialized = True
-                if self._preload_opt_states is not None:
-                    self.load_optimizer_states(self._preload_opt_states)
-                    self._preload_opt_states = None
+                self._finish_optimizer_init()
                 return
 
         self._kvstore, self._update_on_kvstore = _create_kvstore(
@@ -506,20 +508,24 @@ class Module(BaseModule):
             self._kvstore.set_optimizer(self._optimizer)
         else:
             self._updater = opt.get_updater(optimizer)
+        self._finish_optimizer_init()
 
+    def _finish_optimizer_init(self):
+        """Mark ready + replay any optimizer state queued by a resume
+        (set_params-time preload, reference ``module.py:525-529``)."""
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
-            self.load_optimizer_states(self._preload_opt_states)
-            self._preload_opt_states = None
+            preload, self._preload_opt_states = \
+                self._preload_opt_states, None
+            self.load_optimizer_states(preload)
 
     def borrow_optimizer(self, shared_module):
-        """Borrow optimizer from a shared module
-        (reference ``module.py:531``)."""
+        """Share another module's optimizer state wholesale
+        (reference contract ``module.py:531``)."""
         assert shared_module.optimizer_initialized
-        self._optimizer = shared_module._optimizer
-        self._kvstore = shared_module._kvstore
-        self._update_on_kvstore = shared_module._update_on_kvstore
-        self._updater = shared_module._updater
+        for attr in ("_optimizer", "_kvstore", "_update_on_kvstore",
+                     "_updater"):
+            setattr(self, attr, getattr(shared_module, attr))
         self.optimizer_initialized = True
 
     # ------------------------------------------------------------------
@@ -586,14 +592,12 @@ class Module(BaseModule):
             self._fused_outputs = self._trainer.step(self._staged_batch)
             self._staged_batch = None
             return
+        weights = self._exec_group.param_arrays
+        grads = self._exec_group.grad_arrays
         if self._update_on_kvstore:
-            _update_params_on_kvstore(self._exec_group.param_arrays,
-                                      self._exec_group.grad_arrays,
-                                      self._kvstore)
+            _update_params_on_kvstore(weights, grads, self._kvstore)
         else:
-            _update_params(self._exec_group.param_arrays,
-                           self._exec_group.grad_arrays,
-                           updater=self._updater,
+            _update_params(weights, grads, updater=self._updater,
                            num_device=len(self._context),
                            kvstore=self._kvstore)
 

@@ -216,20 +216,22 @@ class PythonOp(object):
     def __call__(self, *args, **kwargs):
         return self.get_symbol(*args, **kwargs)
 
+    # default behaviors: identity forward, all-ones backward, shape
+    # passthrough, one data input -> one output
     def forward(self, in_data, out_data):
         out_data[0][:] = in_data[0]
 
     def backward(self, out_grad, in_data, out_data, in_grad):
         in_grad[0][:] = 1.0
 
-    def infer_shape(self, in_shape):
-        return in_shape, [in_shape[0]]
+    def list_arguments(self):
+        return ["data"]
 
     def list_outputs(self):
         return ["output"]
 
-    def list_arguments(self):
-        return ["data"]
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
 
     def need_top_grad(self):
         return self.need_top_grad_

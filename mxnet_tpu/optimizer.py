@@ -39,21 +39,19 @@ class Optimizer(object):
                  clip_gradient=None, learning_rate=0.01,
                  lr_scheduler=None, sym=None, begin_num_update=0):
         self.rescale_grad = rescale_grad
+        self.wd = wd
+        self.clip_gradient = clip_gradient
         self.lr = learning_rate
         self.lr_scheduler = lr_scheduler
         if lr_scheduler is not None:
-            self.lr_scheduler.base_lr = learning_rate
-        self.wd = wd
-        self.clip_gradient = clip_gradient
-        self.begin_num_update = begin_num_update
-        self.num_update = begin_num_update
+            lr_scheduler.base_lr = learning_rate
+        self.num_update = self.begin_num_update = begin_num_update
         self._index_update_count = {}
-        if param_idx2name is None:
-            param_idx2name = {}
-        if not isinstance(param_idx2name, dict):
+        if param_idx2name is not None \
+                and not isinstance(param_idx2name, dict):
             raise MXNetError(
                 "param_idx2name should be a dict of param indexes to names.")
-        self.idx2name = param_idx2name.copy()
+        self.idx2name = dict(param_idx2name or {})
         self.sym = sym
         self._compiled = None
         self._noise_key = jax.random.key(12345)

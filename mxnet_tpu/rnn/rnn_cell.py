@@ -75,17 +75,22 @@ class _CompoundCell(object):
 
 
 class RNNParams(object):
-    """Container for holding variables (reference ``rnn_cell.py:60``)."""
+    """Prefix-scoped variable container shared between cells
+    (reference contract ``rnn_cell.py:60``): ``get`` interns one
+    Variable per full name, so weight-tied cells resolve to the same
+    symbol node."""
 
     def __init__(self, prefix=""):
         self._prefix = prefix
         self._params = {}
 
     def get(self, name, **kwargs):
-        name = self._prefix + name
-        if name not in self._params:
-            self._params[name] = symbol.Variable(name, **kwargs)
-        return self._params[name]
+        full = self._prefix + name
+        try:
+            return self._params[full]
+        except KeyError:
+            var = self._params[full] = symbol.Variable(full, **kwargs)
+            return var
 
 
 class BaseRNNCell(object):
@@ -103,13 +108,13 @@ class BaseRNNCell(object):
     def reset(self):
         self._init_counter = self._counter = -1
 
-    def __call__(self, inputs, states):
-        raise NotImplementedError()
-
     @property
     def params(self):
-        self._own_params = False
+        self._own_params = False     # a read implies sharing
         return self._params
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError()
 
     @property
     def state_info(self):
@@ -476,6 +481,9 @@ class ModifierCell(BaseRNNCell):
     def state_info(self):
         return self.base_cell.state_info
 
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
     def unpack_weights(self, args):        # checkpoint I/O delegates to
         return self.base_cell.unpack_weights(args)
 
@@ -491,9 +499,6 @@ class ModifierCell(BaseRNNCell):
 
     def pack_weights(self, args):          # the wrapped cell's layout
         return self.base_cell.pack_weights(args)
-
-    def __call__(self, inputs, states):
-        raise NotImplementedError
 
 
 class ZoneoutCell(ModifierCell):

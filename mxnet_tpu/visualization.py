@@ -12,6 +12,15 @@ from .base import MXNetError
 from .symbol import Symbol
 
 
+def _internal_shapes(symbol, shape):
+    """Shapes of every internal output, keyed by output name."""
+    internals = symbol.get_internals()
+    _, out_shapes, _ = internals.infer_shape(**dict(shape))
+    if out_shapes is None:
+        raise ValueError("Input shape is incomplete")
+    return dict(zip(internals.list_outputs(), out_shapes))
+
+
 def print_summary(symbol, shape=None, line_length=120, positions=None):
     """Print a layer summary table (reference ``visualization.py:22``)."""
     if positions is None:
@@ -22,11 +31,7 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
     shape_dict = {}
     if shape is not None:
         show_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**dict(shape))
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+        shape_dict = _internal_shapes(symbol, shape)
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
     heads = {x[0] for x in conf["heads"]}
@@ -53,37 +58,34 @@ def print_summary(symbol, shape=None, line_length=120, positions=None):
         pre_node = []
         pre_filter = 0
         if op != "null":
-            inputs = node["inputs"]
-            for item in inputs:
-                input_node = nodes[item[0]]
-                input_name = input_node["name"]
-                if input_node["op"] != "null" or item[0] in heads:
-                    pre_node.append(input_name)
-                    if show_shape:
-                        key = input_name + "_output" if input_node["op"] != "null" \
-                            else input_name
-                        if key in shape_dict:
-                            pre_filter = pre_filter + int(shape_dict[key][1]) \
-                                if len(shape_dict[key]) > 1 else pre_filter
-        cur_param = 0
+            for src_id, *_ in node["inputs"]:
+                src = nodes[src_id]
+                if src["op"] == "null" and src_id not in heads:
+                    continue      # plain parameter variables don't count
+                pre_node.append(src["name"])
+                if not show_shape:
+                    continue
+                key = src["name"] + ("_output" if src["op"] != "null"
+                                     else "")
+                shp = shape_dict.get(key)
+                if shp is not None and len(shp) > 1:
+                    pre_filter += int(shp[1])
         attrs = node.get("attrs", {})
         if op == "Convolution":
-            num_filter = int(attrs["num_filter"])
-            kernel = eval(attrs["kernel"])  # noqa: S307 - trusted json attr
-            cur_param = pre_filter * num_filter
-            for k in kernel:
-                cur_param *= k
-            cur_param += num_filter
+            k_elems = 1
+            for k in eval(attrs["kernel"]):  # noqa: S307 trusted attr
+                k_elems *= k
+            cur_param = int(attrs["num_filter"]) * (pre_filter * k_elems
+                                                    + 1)
         elif op == "FullyConnected":
-            num_hidden = int(attrs["num_hidden"])
-            cur_param = pre_filter * num_hidden + num_hidden
+            cur_param = (pre_filter + 1) * int(attrs["num_hidden"])
         elif op == "BatchNorm":
-            cur_param = pre_filter * 4
-        first_connection = "" if not pre_node else pre_node[0]
-        fields = ["%s(%s)" % (node["name"], op),
-                  "x".join([str(x) for x in out_shape]),
-                  cur_param, first_connection]
-        print_row(fields, positions)
+            cur_param = 4 * pre_filter
+        else:
+            cur_param = 0
+        print_row(["%s(%s)" % (node["name"], op),
+                   "x".join(str(x) for x in out_shape),
+                   cur_param, pre_node[0] if pre_node else ""], positions)
         for i in range(1, len(pre_node)):
             fields = ["", "", "", pre_node[i]]
             print_row(fields, positions)
@@ -119,11 +121,7 @@ def plot_network(symbol, title="plot", save_format="pdf", shape=None,
     shape_dict = {}
     if shape is not None:
         draw_shape = True
-        interals = symbol.get_internals()
-        _, out_shapes, _ = interals.infer_shape(**dict(shape))
-        if out_shapes is None:
-            raise ValueError("Input shape is incomplete")
-        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+        shape_dict = _internal_shapes(symbol, shape)
     conf = json.loads(symbol.tojson())
     nodes = conf["nodes"]
     node_attr = {"shape": "box", "fixedsize": "true", "width": "1.3",
