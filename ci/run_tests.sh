@@ -1,44 +1,100 @@
 #!/bin/bash
 # CI driver (the reference's Jenkinsfile matrix, SURVEY §2.6/§4):
 #   1. native build
-#   2. unit suite on the virtual 8-device CPU mesh
-#   3. multi-process distributed tests (local launcher)
-#   4. cpu-vs-tpu consistency (skips cleanly without a TPU)
-#   5. driver entry points (bench JSON + multichip dryrun)
+#   2. chip-bound lane IN THE BACKGROUND (cpu-vs-tpu consistency sample,
+#      driver entry points, bench, one-net inference smoke) — these wait
+#      on the tunnel most of their wall, so they overlap the CPU-bound
+#      unit suite on the 1-core CI host
+#   3. unit suite on the virtual 8-device CPU mesh
+#   4. multi-process distributed + crash-recovery (local launcher)
+#   5. join the chip lane
 #
 # Two tiers, like the reference's PR-gate vs nightly split:
-#   default            — fast gate: core suite + the quick example
-#                        smokes ("-m 'not slow_example'").  Measured
-#                        on the 1-core CI host WITH a chip attached:
-#                        ~35-40 min end-to-end (unit ~13 +
-#                        dist/recovery 2 + TPU-attached consistency/
-#                        bench/inference ~20-25); ~15 min without a
-#                        chip.
-#   MXTPU_CI_FULL=1    — everything: all 25+ example trainings run
-#                        end-to-end.  Measured: 64 min total with a
-#                        chip (42 min unit stage); a multi-core host
-#                        parallelizes the example subprocesses.  This
-#                        is the nightly tier.
-# Each stage echoes a timestamp so wall-time regressions are visible
-# in the log.  Quick iteration while developing:
+#   default            — fast gate.  Stage budget, MEASURED on the
+#                        chip-attached 1-core CI host (2026-08-01,
+#                        00:58:18->01:11:33): build 0.2 + unit 11.1 +
+#                        dist 1.2 + recovery 0.8 min, chip lane 13.1
+#                        fully overlapped => **13m15s wall** (was 41 min
+#                        in round 4); ~12 min without a chip (the
+#                        chip-only smokes self-skip).
+#                        Defers to nightly: slow_example trainings,
+#                        nightly-marked example smokes + the C-ABI
+#                        training drive, full consistency registry,
+#                        full inference zoo, 3-worker dist cases.
+#   MXTPU_CI_FULL=1    — everything, serially (the nightly tier).
+# Each stage echoes a timestamp so wall-time regressions are visible.
+# Quick iteration while developing:
 #   python -m pytest tests/ -x -q -k "not examples and not lowp"
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 stage() { echo "=== $1 ($(date +%H:%M:%S)) ==="; }
 
-# bound the bench's real-input-pipeline section in CI (a knob, see
-# bench.py _pipeline_bench; the driver's perf run uses the default)
+# bound the bench's real-input-pipeline windows in CI (a knob, see
+# bench.py; the driver's perf run uses the defaults)
 export MXTPU_BENCH_PIPELINE_STEPS="${MXTPU_BENCH_PIPELINE_STEPS:-4}"
 
-PYTEST_MARK=(-m "not slow_example")
-if [ "${MXTPU_CI_FULL:-0}" = "1" ]; then
+FULL="${MXTPU_CI_FULL:-0}"
+PYTEST_MARK=(-m "not slow_example and not nightly")
+if [ "$FULL" = "1" ]; then
     PYTEST_MARK=()
 fi
 
 stage "native build"
 make -C native
 
+# ---------------------------------------------------------------- chip lane
+HAVE_CHIP=0
+if python -c "import jax,sys; sys.exit(0 if jax.devices()[0].platform in ('tpu','axon') else 1)" 2>/dev/null; then
+    HAVE_CHIP=1
+fi
+
+chip_lane() {
+    set -euo pipefail
+    stage "chip lane: cpu-vs-tpu consistency"
+    if [ "$FULL" = "1" ]; then
+        python tests/nightly/consistency.py
+    else
+        # bounded sweep for the gate; the nightly runs the full registry
+        python tests/nightly/consistency.py --sample 4
+    fi
+    stage "chip lane: driver entry points"
+    python __graft_entry__.py
+    if [ "$FULL" = "1" ]; then
+        python bench.py
+    else
+        MXTPU_BENCH_STREAM_PROBE=0 python bench.py
+    fi
+    if [ "$HAVE_CHIP" = "1" ]; then
+        stage "chip lane: inference scoring smoke"
+        # numbers under gate load are NOT representative; the committed
+        # INFER_BENCH.json comes from a dedicated idle-host run with
+        # default windows (docs/how_to/perf.md)
+        if [ "$FULL" = "1" ]; then
+            python examples/image-classification/benchmark_score.py \
+                --batch-sizes 32 --num-batches 20 \
+                --out /tmp/infer_bench_ci.json
+        fi
+        # int8-tier plumbing smoke on ONE net either way (zoo-wide
+        # quantization adds ~15 min of per-net init that belongs in the
+        # artifact capture, not the gate)
+        python examples/image-classification/benchmark_score.py \
+            --networks resnet-50 --batch-sizes 32 --num-batches 20 \
+            --dtypes float32,int8 --out /tmp/infer_bench_ci_int8.json
+    fi
+    stage "chip lane: done"
+}
+
+CHIP_LOG="$(mktemp)"
+if [ "$FULL" = "1" ]; then
+    # nightly: serial, full fidelity — no overlap to keep timings clean
+    chip_lane
+else
+    chip_lane > "$CHIP_LOG" 2>&1 &
+    CHIP_PID=$!
+fi
+
+# ---------------------------------------------------------------- cpu lanes
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
@@ -51,7 +107,7 @@ python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_mlp.py
 python tools/launch.py -n 2 --launcher local -- \
     python tests/nightly/dist_fused_mlp.py
-if [ "${MXTPU_CI_FULL:-0}" = "1" ]; then
+if [ "$FULL" = "1" ]; then
     # nightly: the sum semantics must hold beyond the 2-worker case
     python tools/launch.py -n 3 --launcher local -- \
         python tests/nightly/dist_sync_kvstore.py
@@ -68,32 +124,20 @@ stage "crash-restart recovery (auto-restart orchestration)"
 # heartbeats over the jax.distributed coordination service (no shared
 # filesystem; the file transport is unit-tested in test_health.py)
 RESUME_DIR="$(mktemp -d)"
-trap 'rm -rf "$RESUME_DIR"' EXIT
+trap 'rm -rf "$RESUME_DIR" "$CHIP_LOG"' EXIT
 MXTPU_HEARTBEAT_TRANSPORT=kv python tools/launch.py -n 2 --launcher local \
     --auto-restart 1 -- python tests/nightly/dist_resume.py "$RESUME_DIR"
 
-stage "cpu-vs-tpu consistency"
-python tests/nightly/consistency.py
-
-stage "driver entry points"
-python __graft_entry__.py
-python bench.py
-
-stage "inference zoo scoring path (TPU only; bounded window)"
-# smoke-validates the scoring path when a chip is attached.  The CI
-# window is small AND the host is under full gate load, so the numbers
-# are NOT representative — the committed INFER_BENCH.json comes from a
-# dedicated idle-host run of the same command with default windows
-# (docs/how_to/perf.md documents the ±10% tunnel noise band even then).
-if python -c "import jax,sys; sys.exit(0 if jax.devices()[0].platform in ('tpu','axon') else 1)" 2>/dev/null; then
-    python examples/image-classification/benchmark_score.py \
-        --batch-sizes 32 --num-batches 20 --out /tmp/infer_bench_ci.json
-    # int8-tier plumbing smoke on ONE net: zoo-wide quantization adds
-    # a per-net CPU init + quantize + extra compile (~15 min measured)
-    # that belongs in the artifact capture, not the gate
-    python examples/image-classification/benchmark_score.py \
-        --networks resnet-50 --batch-sizes 32 --num-batches 20 \
-        --dtypes int8 --out /tmp/infer_bench_ci_int8.json
+# ---------------------------------------------------------------- join
+if [ "$FULL" != "1" ]; then
+    stage "waiting for the chip lane"
+    CHIP_OK=0
+    wait "$CHIP_PID" || CHIP_OK=$?
+    cat "$CHIP_LOG"
+    if [ "$CHIP_OK" != "0" ]; then
+        echo "chip lane FAILED (exit $CHIP_OK)" >&2
+        exit "$CHIP_OK"
+    fi
 fi
 
 stage "CI OK"

@@ -30,6 +30,11 @@ def pytest_configure(config):
         "slow_example: multi-minute example training; the fast CI gate "
         "skips these (ci/run_tests.sh runs them under MXTPU_CI_FULL=1, "
         "as does the nightly)")
+    config.addinivalue_line(
+        "markers",
+        "nightly: minute-plus compile-heavy coverage (example smokes, "
+        "the C-ABI training drive) that the fast gate defers to the "
+        "MXTPU_CI_FULL=1 tier to stay inside its wall-time bound")
 
 
 @pytest.fixture(autouse=True)

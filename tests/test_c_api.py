@@ -480,6 +480,7 @@ def test_c_recordio_autograd_profiler(tmp_path):
     lib.MXNDArrayFree(a)
 
 
+@pytest.mark.nightly       # g++ compile + full training drive, ~2 min
 @pytest.mark.skipif(not os.path.exists(_LIB),
                     reason="libmxtpu_c_api.so not built")
 def test_cpp_train_lenet_through_c_abi(tmp_path):

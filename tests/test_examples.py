@@ -9,6 +9,11 @@ import sys
 
 import pytest
 
+# example smokes are coverage the NIGHTLY tier owns: each is a real
+# (subprocess) training run with its own compile, minutes apiece — the
+# fast gate's wall-time bound can't carry them
+pytestmark = pytest.mark.nightly
+
 _ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

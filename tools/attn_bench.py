@@ -112,6 +112,9 @@ def main(argv=None):
         if "flash_fwd_ms" in row and "naive_fwd_ms" in row:
             row["fwd_speedup"] = round(
                 row["naive_fwd_ms"] / row["flash_fwd_ms"], 2)
+        if "flash_fwdbwd_ms" in row and "naive_fwdbwd_ms" in row:
+            row["fwdbwd_speedup"] = round(
+                row["naive_fwdbwd_ms"] / row["flash_fwdbwd_ms"], 2)
         rows.append(row)
         print(json.dumps(row), file=sys.stderr)
 
