@@ -74,7 +74,7 @@ def _build_module(mx, models, batch, image, ctx=None):
     # remaining ceiling is HBM bandwidth: tools/roofline.py measures this
     # chip at ~181 TF/s bf16 / ~587 GB/s (ROOFLINE.json); XLA's cost
     # analysis puts the step's byte traffic at the bandwidth roofline, so
-    # the step runs ~30% MFU — ResNet's low-arithmetic-intensity stages
+    # the step runs ~37% MFU — ResNet's low-arithmetic-intensity stages
     # (stem, BN, early blocks) are bandwidth-bound, not MXU-bound.
     sym = models.get_symbol("resnet-50", num_classes=1000, layout="NHWC")
     mod = mx.mod.Module(context=ctx if ctx is not None else mx.tpu(),

@@ -449,13 +449,32 @@ def _batch_norm(p, c, data, gamma, beta, moving_mean, moving_var):
         gamma = lax.stop_gradient(jnp.ones_like(gamma))
     use_batch_stats = c.is_train and not p["use_global_stats"]
     if use_batch_stats:
-        # accumulate statistics in f32: a bf16 sum over N*H*W elements
-        # loses the mean entirely (8 mantissa bits); XLA fuses the
-        # widening cast into the reduction so HBM traffic is unchanged
+        # SINGLE-PASS statistics with f32 accumulation: sum(x-c) and
+        # sum((x-c)^2) reduce together over ONE read of the bf16
+        # activation (jnp.var's (x-mean)^2 formulation needs a second
+        # full pass — on a byte-bound step the extra read of the
+        # widened activation is the cost; the f32 convert_reduce
+        # fusions that topped STEP_BREAKDOWN.json through round 4).
+        # Centering on the RUNNING mean c (an aux input — free) guards
+        # the E[.]-mean^2 cancellation: at steady state c tracks the
+        # batch mean, so the subtraction is between near-equal small
+        # quantities only in the benign regime.  A bf16 accumulator
+        # would lose the mean entirely (8 mantissa bits); variance is
+        # clamped at 0 against residual rounding.  (LayerNorm and
+        # InstanceNorm keep exact two-pass jnp.var: their reductions
+        # stay within one VMEM-resident row, where the second pass
+        # costs no HBM traffic.)
         stat_in = data.astype(jnp.float32) \
             if data.dtype in (jnp.bfloat16, jnp.float16) else data
-        mean = jnp.mean(stat_in, axis=reduce_axes).astype(data.dtype)
-        var = jnp.var(stat_in, axis=reduce_axes).astype(data.dtype)
+        center = lax.stop_gradient(
+            moving_mean.astype(jnp.float32)).reshape(bshape)
+        xc = stat_in - center
+        n_red = np.prod([data.shape[i] for i in reduce_axes])
+        d1 = jnp.sum(xc, axis=reduce_axes) / n_red
+        d2 = jnp.sum(xc * xc, axis=reduce_axes) / n_red
+        var32 = jnp.maximum(d2 - d1 * d1, 0.0)
+        mean = (d1 + center.reshape(d1.shape)).astype(data.dtype)
+        var = var32.astype(data.dtype)
         m = p["momentum"]
         new_mean = moving_mean * m + lax.stop_gradient(mean) * (1 - m)
         new_var = moving_var * m + lax.stop_gradient(var) * (1 - m)

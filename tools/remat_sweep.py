@@ -2,7 +2,7 @@
 """Sweep rematerialization policies on the bench model and record the
 throughput + XLA cost-model accounting for each.
 
-The fused ResNet-50 step is HBM-bandwidth-bound (~33% MFU with the MXU
+The fused ResNet-50 step is HBM-bandwidth-bound (~37% MFU with the MXU
 two-thirds idle — ROOFLINE.json / BENCH_r03): remat trades free MXU
 flops for scarce HBM bytes by saving fewer residuals and recomputing
 the rest inside backward.  This tool measures each policy end-to-end on

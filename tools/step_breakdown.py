@@ -336,6 +336,33 @@ def main():
                              if r["bound"] == "hbm"), 2),
             "mxu": round(sum(r["roofline_ms"] for r in rows
                              if r["bound"] == "mxu"), 2)},
+        # the round-5 byte attack, kept with the artifact so a
+        # regeneration never drops the history the numbers rest on
+        "round5_attack": {
+            "convert_reduce f32 BN-stat chains (r4 top: 3x0.92 + "
+            "0.82 GB)":
+                "ATTACKED: BatchNorm computes sum(x-c)/sum((x-c)^2) in "
+                "ONE f32-accumulated pass over the bf16 activation, "
+                "centered on the running mean (was jnp.var's two-pass "
+                "(x-mean)^2). Result: cost-model 80.68 -> 71.03 "
+                "GB/step, measured step 108.2 -> 96.6 ms, headline "
+                "2486 -> 2781 img/s (~37% MFU); the convert_reduce "
+                "fusions left the top table.",
+            "select_and_scatter.9 (0.925 GB, MaxPool backward)":
+                "analyzed, declined: 1.3% of step bytes (~1.3 ms). An "
+                "equality-mask backward avoids the re-read but "
+                "distributes gradient to ALL tied maxima where "
+                "select-and-scatter picks the first — a semantics "
+                "change for ~1 ms.",
+            "zero-flop 1.64 GB fusions (r4 .64/.65, now .37/.38)":
+                "identified via HLO dump: the stage-2/3 residual-join "
+                "backward chains — bf16 activations re-read for "
+                "BN/ReLU backward plus the gradient-stream adds at "
+                "each residual merge (7 big operands each). "
+                "Irreducible without rematerialization, and every "
+                "remat policy measured SLOWER on this byte-bound step "
+                "(REMAT_SWEEP.json).",
+        },
     }
     out = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "STEP_BREAKDOWN.json")
