@@ -98,6 +98,13 @@ def main(argv=None):
 
     best = max(rows, key=lambda r: r["img_per_sec"])
     result = {"model": "resnet-50 NHWC bf16 batch %d" % args.batch,
+              "note": ("rates here read a few %% below the BENCH "
+                       "headline for the same policy because this "
+                       "tool times a %d-step window per policy while "
+                       "bench.py amortizes fixed overheads over a "
+                       "longer one; both share tools/stepcost timing, "
+                       "so any delta is window amortization, not a "
+                       "measurement disagreement" % args.steps),
               "best_policy": best["policy"],
               "best_img_per_sec": best["img_per_sec"],
               "rows": rows}
