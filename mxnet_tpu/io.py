@@ -481,8 +481,8 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
     from the image batch to one index vector (~1 KB).
 
     This is the TPU-native steady-state input pipeline for datasets
-    that fit in HBM (a 16 GB chip holds ~200k 224x224 RGB uint8
-    frames; a data-parallel pod shards num_parts-fashion far beyond
+    that fit in HBM (a 16 GB chip holds ~80k 256x256 RGB uint8
+    storage frames alongside the model; a data-parallel pod shards num_parts-fashion far beyond
     that), and the answer to a slow or serialized host link: epoch 1
     pays decode + wire once, every later batch costs an on-chip gather
     (microseconds).  The reference has no analog — its prefetcher can
@@ -495,11 +495,14 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
     (h, w) is the on-device crop emitted per batch — random when
     ``rand_crop`` else center, plus ``rand_mirror``, matching the
     standard ImageNet augmentation split (host: resize/decode; device:
-    crop + flip)."""
+    crop + flip).  ``mean``/``std`` (per-channel, in the inner
+    iterator's channel order) fold the normalization into the on-device
+    program too — batches then emerge float32; without them uint8
+    frames stay uint8 (the fused trainer casts on device)."""
 
     def __init__(self, inner, data_shape=None, rand_crop=False,
                  rand_mirror=False, shuffle=False, seed=0,
-                 batch_size=None, device=None):
+                 batch_size=None, device=None, mean=None, std=None):
         import jax
         super().__init__(int(batch_size or inner.batch_size))
         self.rand_crop = bool(rand_crop)
@@ -537,6 +540,14 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
             raise MXNetError("crop %s exceeds cached frames %s"
                              % ((ch, cw), (sh, sw)))
         self._crop = (int(ch), int(cw))
+        chans = int(self._data.shape[-1])
+        for what, v in (("mean", mean), ("std", std)):
+            if v is not None and np.asarray(v).size not in (1, chans):
+                raise MXNetError(
+                    "%s has %d entries but cached frames have %d "
+                    "channels" % (what, np.asarray(v).size, chans))
+        self._mean = None if mean is None else np.asarray(mean, np.float32)
+        self._std = None if std is None else np.asarray(std, np.float32)
         self._order = np.arange(n)
         self.cursor = -self.batch_size
         self._aug = self._build_augment()
@@ -550,6 +561,7 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
         ch, cw = self._crop
         chans = int(self._data.shape[-1])
         rand_crop, rand_mirror = self.rand_crop, self.rand_mirror
+        mean, std = self._mean, self._std
 
         def augment(data, labels, idx, key):
             imgs = jnp.take(data, idx, axis=0)          # [B, H, W, C]
@@ -569,6 +581,12 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
                 flip = jax.random.bernoulli(km, 0.5, (B,))
                 crop = jnp.where(flip[:, None, None, None],
                                  crop[:, :, ::-1, :], crop)
+            if mean is not None or std is not None:
+                crop = crop.astype(jnp.float32)
+                if mean is not None:
+                    crop = crop - mean
+                if std is not None:
+                    crop = crop / std
             return crop, jnp.take(labels, idx, axis=0)
 
         return jax.jit(augment)
@@ -577,7 +595,10 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
     def provide_data(self):
         ch, cw = self._crop
         shape = (self.batch_size, ch, cw, int(self._data.shape[-1]))
-        return [DataDesc(self.data_name, shape, self._data.dtype)]
+        out_t = np.float32 if (self._mean is not None
+                               or self._std is not None) \
+            else self._data.dtype
+        return [DataDesc(self.data_name, shape, out_t)]
 
     @property
     def provide_label(self):

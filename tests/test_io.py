@@ -657,3 +657,21 @@ def test_device_cache_iter_feeds_fit():
                 initializer=mx.init.Xavier())
     finally:
         os.environ.pop("MXTPU_MODULE_FUSED", None)
+
+
+def test_device_cache_iter_on_device_normalization():
+    """mean/std fold into the on-device program: emitted batches are
+    f32 and value-equal to (u8 - mean) / std of the center crop."""
+    src = _FrameSource()
+    mean = (10.0, 20.0, 30.0)
+    std = (2.0, 4.0, 5.0)
+    it = io.DeviceCacheIter(src, data_shape=(6, 8), mean=mean, std=std)
+    assert it.provide_data[0].dtype == np.float32
+    b = it.next()
+    got = b.data[0].asnumpy()
+    assert got.dtype == np.float32
+    y0, x0 = (src.H - 6) // 2, (src.W - 8) // 2
+    raw = src.frames[:8, y0:y0 + 6, x0:x0 + 8, :].astype(np.float32)
+    want = (raw - np.asarray(mean, np.float32)) / np.asarray(std,
+                                                             np.float32)
+    np.testing.assert_allclose(got, want, rtol=1e-6)
