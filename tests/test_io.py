@@ -675,3 +675,25 @@ def test_device_cache_iter_on_device_normalization():
     want = (raw - np.asarray(mean, np.float32)) / np.asarray(std,
                                                              np.float32)
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_device_cache_iter_shards_with_num_parts(tmp_path):
+    """The docs' pod recipe: each worker caches only ITS num_parts
+    shard — two part caches are disjoint and together cover the set."""
+    from mxnet_tpu.io import DeviceCacheIter, NativeImageRecordIter
+    from mxnet_tpu._native import dataloader_lib
+    if dataloader_lib() is None:
+        pytest.skip("native data loader not built")
+    rec_path = _write_jpeg_rec(tmp_path, "shard.rec", 12, hw=(20, 20))
+    seen = []
+    for part in (0, 1):
+        loader = NativeImageRecordIter(
+            path_imgrec=rec_path, data_shape=(3, 16, 16), batch_size=3,
+            layout="NHWC", output="numpy", dtype="uint8",
+            num_parts=2, part_index=part, preprocess_threads=1)
+        it = DeviceCacheIter(loader, data_shape=(12, 12))
+        assert it.num_data == 6
+        labels = np.concatenate([b.label[0].asnumpy() for b in it])
+        seen.append(set(labels.astype(int).tolist()))
+    assert seen[0].isdisjoint(seen[1])
+    assert seen[0] | seen[1] == set(range(12))
