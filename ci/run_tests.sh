@@ -22,6 +22,12 @@
 #                        training drive, full consistency registry,
 #                        full inference zoo, 3-worker dist cases.
 #   MXTPU_CI_FULL=1    — everything, serially (the nightly tier).
+#                        Measured on the same host (2026-08-01,
+#                        02:23:21->03:36:36): 73 min — full consistency
+#                        registry (232/232), full unit suite incl.
+#                        slow examples (921 tests, 43 min), full
+#                        inference zoo, dist trio + dist_lenet at 2
+#                        and 3 workers, crash-recovery resume.
 # Each stage echoes a timestamp so wall-time regressions are visible.
 # Quick iteration while developing:
 #   python -m pytest tests/ -x -q -k "not examples and not lowp"
