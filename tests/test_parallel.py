@@ -294,3 +294,55 @@ def test_trainer_remat_composes_with_mesh():
     for n in base:
         np.testing.assert_allclose(base[n], test[n], rtol=2e-5,
                                    atol=2e-6, err_msg=n)
+
+
+def test_device_cache_iter_feeds_data_parallel_mesh():
+    """The HBM-cached input path composes with the fused data-parallel
+    mesh: the cache's single-device augment output is resharded onto
+    the batch axis each step, and the model trains to accuracy."""
+    from mxnet_tpu import io
+    mesh = parallel.make_mesh({"data": 4})
+    rng = np.random.RandomState(0)
+    N, H, W = 64, 10, 10
+    y = (np.arange(N) % 2).astype(np.float32)
+    base = np.where(y > 0, 170, 60)[:, None, None, None]
+    frames = (base + rng.randint(-30, 30, (N, H, W, 3))).clip(
+        0, 255).astype(np.uint8)
+
+    class Src(io.DataIter):
+        def __init__(self):
+            super().__init__(16)
+            self.i = 0
+            self.provide_data = [io.DataDesc("data", (16, H, W, 3),
+                                             np.uint8)]
+            self.provide_label = [io.DataDesc("softmax_label", (16,))]
+
+        def next(self):
+            if self.i >= N:
+                raise StopIteration
+            lo = self.i
+            self.i += 16
+            sel = np.arange(lo, lo + 16) % N
+            return io.DataBatch([frames[sel]], [y[sel]],
+                                pad=max(0, self.i - N))
+
+        def reset(self):
+            self.i = 0
+
+    net = mx.sym.Convolution(mx.sym.Variable("data"), num_filter=4,
+                             kernel=(3, 3), layout="NHWC", name="c")
+    net = mx.sym.Flatten(mx.sym.Activation(net, act_type="relu"))
+    net = mx.sym.FullyConnected(net, num_hidden=2, name="fc")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    stats = dict(mean=(115.0,) * 3, std=(55.0,) * 3)
+    it = io.DeviceCacheIter(Src(), data_shape=(8, 8), rand_crop=True,
+                            rand_mirror=True, shuffle=True, seed=5,
+                            **stats)
+    mod = mx.mod.Module(net, context=mesh)
+    mod.fit(it, num_epoch=15, optimizer="adam",
+            optimizer_params={"learning_rate": 0.005},
+            initializer=mx.init.Xavier())
+    assert mod._trainer is not None and mod._trainer.mesh is mesh
+    ev = io.DeviceCacheIter(Src(), data_shape=(8, 8), **stats)
+    acc = dict(mod.score(ev, "acc"))["accuracy"]
+    assert acc > 0.9, acc
