@@ -199,3 +199,10 @@ def test_kaggle_ndsb2_example():
 @pytest.mark.slow_example
 def test_speech_demo_example():
     _run_example("speech-demo/train_acoustic_toy.py", "--epochs", "5")
+
+
+def test_torch_interop_example():
+    """The plugin/torch analog: a live torch.nn.Module inside the graph,
+    its parameters trained by this framework's optimizer."""
+    pytest.importorskip("torch")
+    _run_example("torch-interop/torch_module.py", timeout=900)
