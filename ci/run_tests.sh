@@ -22,12 +22,16 @@
 #                        training drive, full consistency registry,
 #                        full inference zoo, 3-worker dist cases.
 #   MXTPU_CI_FULL=1    — everything, serially (the nightly tier).
-#                        Measured on the same host (2026-08-01,
-#                        02:23:21->03:36:36): 73 min — full consistency
+#                        Measured on the same host (2026-08-01, two
+#                        runs): 73 and 68 min — full consistency
 #                        registry (232/232), full unit suite incl.
-#                        slow examples (921 tests, 43 min), full
+#                        slow examples (923 tests, ~44 min), full
 #                        inference zoo, dist trio + dist_lenet at 2
-#                        and 3 workers, crash-recovery resume.
+#                        and 3 workers, crash-recovery resume.  Those
+#                        runs still bounded bench.py's pipeline
+#                        windows to 4 steps; the nightly now keeps the
+#                        default 24-step windows, which adds ~3-5 min
+#                        of streaming-pipeline wall to the budget.
 # Each stage echoes a timestamp so wall-time regressions are visible.
 # Quick iteration while developing:
 #   python -m pytest tests/ -x -q -k "not examples and not lowp"
@@ -36,11 +40,15 @@ cd "$(dirname "$0")/.."
 
 stage() { echo "=== $1 ($(date +%H:%M:%S)) ==="; }
 
-# bound the bench's real-input-pipeline windows in CI (a knob, see
-# bench.py; the driver's perf run uses the defaults)
-export MXTPU_BENCH_PIPELINE_STEPS="${MXTPU_BENCH_PIPELINE_STEPS:-4}"
-
 FULL="${MXTPU_CI_FULL:-0}"
+
+# bound the bench's real-input-pipeline windows in the FAST gate only
+# (a knob, see bench.py; the nightly and the driver's perf run keep the
+# default 24-step windows — a 4-step window under gate load reads the
+# pipeline ~2x low)
+if [ "$FULL" != "1" ]; then
+    export MXTPU_BENCH_PIPELINE_STEPS="${MXTPU_BENCH_PIPELINE_STEPS:-4}"
+fi
 PYTEST_MARK=(-m "not slow_example and not nightly")
 if [ "$FULL" = "1" ]; then
     PYTEST_MARK=()
