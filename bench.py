@@ -374,6 +374,23 @@ def main():
         if roof.get("hbm_gbps"):
             line["hbm_frac_upper_bound"] = round(
                 byts * (img_s / batch) / 1e9 / roof["hbm_gbps"], 3)
+        # byte-budget diff (informational here; the nightly tier gates
+        # via `tools/step_breakdown.py --check` — docs/how_to/perf.md
+        # "Byte diet").  Own except: a malformed budget file must not
+        # masquerade as an MFU-accounting failure.
+        try:
+            line["dtype_policy"] = mod._trainer.dtype_policy or "bytediet"
+            from tools.step_breakdown import check_byte_budget, load_budget
+            budget = load_budget() or {}
+            entry = budget.get("tpu" if on_tpu else "cpu")
+            if entry is not None:
+                ok, delta_pct = check_byte_budget(
+                    byts / 1e9, entry, budget.get("tolerance_pct"))
+                line["byte_budget_gb"] = entry["cost_model_gb_per_step"]
+                line["byte_budget_delta_pct"] = delta_pct
+                line["byte_budget_ok"] = ok
+        except Exception as e:                      # noqa: BLE001
+            line["byte_budget_error"] = str(e)
     except Exception as e:                          # noqa: BLE001
         # never silently lose the MFU fields again (round-3 verdict #6)
         line["mfu_error"] = str(e)

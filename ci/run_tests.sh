@@ -32,6 +32,9 @@
 #                        windows to 4 steps; the nightly now keeps the
 #                        default 24-step windows, which adds ~3-5 min
 #                        of streaming-pipeline wall to the budget.
+#                        Round 6 adds the byte-budget gate (one more
+#                        fused-step compile: ~1-2 min on chip, ~1.5 min
+#                        on the CPU shape) — see STEP_BYTE_BUDGET.json.
 # Each stage echoes a timestamp so wall-time regressions are visible.
 # Quick iteration while developing:
 #   python -m pytest tests/ -x -q -k "not examples and not lowp"
@@ -78,6 +81,16 @@ chip_lane() {
         python bench.py
     else
         MXTPU_BENCH_STREAM_PROBE=0 python bench.py
+    fi
+    if [ "$FULL" = "1" ]; then
+        # nightly byte-budget gate: recapture the fused step for this
+        # platform, attribute top fusions to symbol layers, upload the
+        # breakdown as an artifact, and FAIL on a >3% regression of
+        # cost_model_gb_per_step vs the checked-in STEP_BYTE_BUDGET.json
+        # (ratchet after intentional byte wins with --write-budget)
+        stage "chip lane: byte-budget gate"
+        python tools/step_breakdown.py --check \
+            --artifact-dir "${MXTPU_ARTIFACT_DIR:-/tmp/mxtpu_artifacts}"
     fi
     if [ "$HAVE_CHIP" = "1" ]; then
         stage "chip lane: inference scoring smoke"

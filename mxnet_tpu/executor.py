@@ -66,6 +66,9 @@ class _GraphProgram:
                                 and n.op.rng_in_eval for n in self.nodes)
         # target backend for platform-specialized op lowerings
         self.platform = None
+        # residual/intermediate dtype policy for backward formulations
+        # (op/bytediet.py); None inherits the process default
+        self.dtype_policy = None
         # group2ctx placement: node name -> jax device.  The TPU analog
         # of the reference's PlaceDevice pass + _CrossDeviceCopy insertion
         # (src/executor/graph_executor.cc:241-318): inside the single
@@ -89,8 +92,15 @@ class _GraphProgram:
         if n.op.uses_rng:
             rng = jax.random.fold_in(rng_key, len(env))
         ctx = OpContext(is_train=is_train, rng=rng,
-                        platform=self.platform)
-        outs, aux_updates = n.op.apply(n.params, ctx, *(in_vals + node_aux))
+                        platform=self.platform,
+                        dtype_policy=self.dtype_policy)
+        # the named scope stamps the symbol name into the XLA metadata
+        # (op_name="jit(..)/<node>/..") of every primitive this node
+        # traces — tools/step_breakdown.py joins per-fusion HBM bytes
+        # back to symbol-level layers through it
+        with jax.named_scope(n.name):
+            outs, aux_updates = n.op.apply(n.params, ctx,
+                                           *(in_vals + node_aux))
         dev = self.placement.get(n.name)
         if dev is not None:
             outs = tuple(jax.device_put(o, dev) for o in outs)

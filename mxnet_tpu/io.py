@@ -143,7 +143,14 @@ class _CurrentBatchAccessors(object):
 class ResizeIter(_CurrentBatchAccessors, DataIter):
     """Clamp (or stretch) another iterator to exactly ``size`` batches
     per epoch, wrapping the inner iterator's epochs as needed
-    (reference contract ``io.py:216-278``)."""
+    (reference contract ``io.py:216-278``).
+
+    Contract note (intentional hardening vs the reference): an inner
+    iterator that yields NO batches even after a reset raises
+    ``MXNetError`` from ``iter_next`` instead of silently propagating
+    ``StopIteration`` — a resized-to-N epoch over an empty source is a
+    configuration error (the caller asked for ``size`` batches that can
+    never exist), not an empty epoch."""
 
     def __init__(self, data_iter, size, reset_internal=True):
         super().__init__(data_iter.batch_size)
@@ -330,6 +337,14 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
     ``stats()`` reports where the worker's wall went — ``upload_s`` vs
     ``source_s`` (inner-iterator wait) — so a pipeline benchmark can
     attribute per-batch time to named stages.
+
+    ``data_shardings`` / ``label_shardings`` may be lists of shardings
+    OR zero-argument callables returning such lists: a callable is
+    resolved PER BATCH, so a wrapper built before the consumer's
+    shardings exist (``Module.fit`` wraps before the fused trainer's
+    first-step compile) stages every batch onto the right devices once
+    they do — instead of snapshotting ``None`` and paying a second
+    ``device_put`` per batch on a data-parallel mesh.
     """
 
     _END = object()
@@ -383,9 +398,16 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
                     return
                 self.source_s += _time.perf_counter() - t0
                 t0 = _time.perf_counter()
-                data = [self._upload(a, self._data_shardings, i)
+                # resolve callable shardings lazily, once per batch
+                data_sh = self._data_shardings() \
+                    if callable(self._data_shardings) \
+                    else self._data_shardings
+                label_sh = self._label_shardings() \
+                    if callable(self._label_shardings) \
+                    else self._label_shardings
+                data = [self._upload(a, data_sh, i)
                         for i, a in enumerate(b.data)]
-                label = [self._upload(a, self._label_shardings, i)
+                label = [self._upload(a, label_sh, i)
                          for i, a in enumerate(b.label or [])]
                 jax.block_until_ready([a.data for a in data + label])
                 self.upload_s += _time.perf_counter() - t0
@@ -615,13 +637,16 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
             self._rng.shuffle(self._order)
 
     def iter_next(self):
-        self.cursor += self.batch_size
-        return self.cursor < self.num_data
-
-    def next(self):
+        """Advance the cursor AND stage ``current_batch``, so the
+        legacy split protocol (``iter_next()`` then ``getdata()`` /
+        ``getlabel()``) observes the batch just advanced to — the same
+        contract ``DeviceUploadIter.iter_next`` keeps (previously only
+        the cursor moved and the accessors returned the PREVIOUS
+        batch)."""
         import jax
-        if not self.iter_next():
-            raise StopIteration
+        self.cursor += self.batch_size
+        if self.cursor >= self.num_data:
+            return False
         lo = self.cursor
         hi = lo + self.batch_size
         pad = max(0, hi - self.num_data)
@@ -633,6 +658,11 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
             data=[NDArray(imgs)], label=[NDArray(lbls)], pad=pad,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
+        return True
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
         return self.current_batch
 
 

@@ -223,13 +223,23 @@ class BaseModule(object):
         if (tr is None or tr.multihost or not enabled
                 or isinstance(train_data, DeviceUploadIter)):
             return train_data
-        data_sh = label_sh = None
-        bs = tr._batch_shardings
-        if bs is not None:
-            data_sh = [bs.get(n) for n in self._data_names]
-            label_sh = [bs.get(n) for n in self._label_names]
-        return DeviceUploadIter(train_data, data_shardings=data_sh,
-                                label_shardings=label_sh)
+
+        # LAZY sharding resolution (resolved by the upload worker per
+        # batch): tr._batch_shardings is populated by the trainer's
+        # bind/compile, which may happen after this wrapper is built —
+        # snapshotting it here staged every batch to the default device
+        # and Trainer._device_batch paid a SECOND device_put per batch
+        # on a data-parallel mesh
+        def _sh(names):
+            def resolve():
+                bs = tr._batch_shardings
+                return [bs.get(n) for n in names] if bs is not None \
+                    else None
+            return resolve
+
+        return DeviceUploadIter(train_data,
+                                data_shardings=_sh(self._data_names),
+                                label_shardings=_sh(self._label_names))
 
     def _train_epoch(self, epoch, train_data, eval_metric,
                      batch_end_callback, monitor):

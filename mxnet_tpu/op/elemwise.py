@@ -106,12 +106,19 @@ _UNARY = {
     "negative": _f.negative,
     "reciprocal": lambda a: 1.0 / a,
     "sigmoid": _sigmoid,
-    "relu": jax.nn.relu,
     "softrelu": jax.nn.softplus,
     "erf": jax.scipy.special.erf,
 }
 for _name, _fn in _UNARY.items():
     _reg_unary(_name, _fn)
+
+from . import bytediet as _bd
+
+
+# relu is ctx-aware: the byte-diet policy derives the backward mask from
+# the (already-resident) output instead of a saved input (op/bytediet.py)
+register("relu", lambda p, c, a: _bd.relu_save_output(a)
+         if _bd.enabled(c) else jax.nn.relu(a))
 
 register("identity", lambda p, c, a: a)
 alias("_copy", "identity")

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import MXNetError
+from . import bytediet as _bd
 from .registry import Param, register, alias
 
 
@@ -280,9 +281,18 @@ def _pooling(p, c, data):
         strides = (1, 1) + stride
         padding = ((0, 0), (0, 0)) + tuple(lo_hi)
     if p["pool_type"] == "max":
-        init = (np.array(-np.inf, data.dtype)
-                if jnp.issubdtype(data.dtype, jnp.floating)
-                else np.array(np.iinfo(np.dtype(data.dtype)).min, data.dtype))
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            if c.is_train and _bd.enabled(c):
+                # byte-diet backward: forward computes value+argmax in
+                # one variadic reduce_window pass, backward scatter-adds
+                # the cotangent at the saved indices — no
+                # select_and_scatter, no activation re-read
+                # (op/bytediet.py).  Eval traces keep the plain reduce
+                # (no index map to pay for).
+                return _bd.max_pool_argmax(data, window, strides, padding)
+            init = np.array(-np.inf, data.dtype)
+        else:
+            init = np.array(np.iinfo(np.dtype(data.dtype)).min, data.dtype)
         return lax.reduce_window(data, init, lax.max,
                                  window, strides, padding)
     summed = lax.reduce_window(data, np.array(0, data.dtype), lax.add,
@@ -332,6 +342,10 @@ def _pool_infer_shape(p, in_shapes):
                                    "gelu")),),
           hint="activation")
 def _activation(p, c, a):
+    if p["act_type"] == "relu" and _bd.enabled(c):
+        # backward mask from the output (already resident — the next
+        # layer's residual) instead of a saved input: op/bytediet.py
+        return _bd.relu_save_output(a)
     return {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
             "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
             "gelu": jax.nn.gelu}[p["act_type"]](a)
@@ -455,29 +469,32 @@ def _batch_norm(p, c, data, gamma, beta, moving_mean, moving_var):
         # full pass — on a byte-bound step the extra read of the
         # widened activation is the cost; the f32 convert_reduce
         # fusions that topped STEP_BREAKDOWN.json through round 4).
-        # Centering on the RUNNING mean c (an aux input — free) guards
-        # the E[.]-mean^2 cancellation: at steady state c tracks the
-        # batch mean, so the subtraction is between near-equal small
-        # quantities only in the benign regime.  A bf16 accumulator
-        # would lose the mean entirely (8 mantissa bits); variance is
-        # clamped at 0 against residual rounding.  (LayerNorm and
-        # InstanceNorm keep exact two-pass jnp.var: their reductions
-        # stay within one VMEM-resident row, where the second pass
-        # costs no HBM traffic.)
-        stat_in = data.astype(jnp.float32) \
-            if data.dtype in (jnp.bfloat16, jnp.float16) else data
-        center = lax.stop_gradient(
-            moving_mean.astype(jnp.float32)).reshape(bshape)
-        xc = stat_in - center
-        n_red = np.prod([data.shape[i] for i in reduce_axes])
-        d1 = jnp.sum(xc, axis=reduce_axes) / n_red
-        d2 = jnp.sum(xc * xc, axis=reduce_axes) / n_red
-        var32 = jnp.maximum(d2 - d1 * d1, 0.0)
-        mean = (d1 + center.reshape(d1.shape)).astype(data.dtype)
+        # Centering on the RUNNING mean c (an aux input — free) keeps
+        # the E[.]-mean^2 subtraction benign at steady state, and
+        # bytediet.bn_batch_stats guards the catastrophic regime (batch
+        # mean far from c: first steps after init, distribution shift)
+        # with a scalar |d1|-vs-sqrt(d2) check that falls back to exact
+        # two-pass statistics.  (LayerNorm and InstanceNorm keep exact
+        # two-pass jnp.var: their reductions stay within one
+        # VMEM-resident row, where the second pass costs no HBM
+        # traffic.)
+        center32 = lax.stop_gradient(moving_mean.astype(jnp.float32))
+        mean32, var32 = _bd.bn_batch_stats(data, center32, reduce_axes)
+        mean = mean32.astype(data.dtype)
         var = var32.astype(data.dtype)
         m = p["momentum"]
         new_mean = moving_mean * m + lax.stop_gradient(mean) * (1 - m)
         new_var = moving_var * m + lax.stop_gradient(var) * (1 - m)
+        if _bd.enabled(c) and not p["output_mean_var"]:
+            # byte-diet backward: closed-form BN gradient as one fused
+            # elementwise pass (dx = x·A + dy·S + B, per-channel f32
+            # A/S/B) instead of autodiff's activation-sized stat-
+            # broadcast temporaries; the duplicate statistics here and
+            # inside the custom vjp CSE into one pass (op/bytediet.py).
+            cfg = (tuple(int(i) for i in reduce_axes), int(ax),
+                   float(p["eps"]))
+            out = _bd.bn_train_normalize(cfg, data, gamma, beta, center32)
+            return out, new_mean, new_var
     else:
         mean, var = moving_mean, moving_var
         new_mean, new_var = moving_mean, moving_var

@@ -45,14 +45,20 @@ class OpContext:
     tracing this op ("tpu"/"cpu"/...; None = process default) — ops with
     backend-specialized kernels (Pallas flash attention) select their
     lowering with it.
+    ``dtype_policy`` selects the residual/intermediate dtype policy for
+    backward formulations ("bytediet"/"legacy"; None = the process
+    default, see ``op/bytediet.py``) — another static trace-time flag,
+    threaded from ``Trainer``/``Executor``.
     """
 
-    __slots__ = ("is_train", "rng", "platform")
+    __slots__ = ("is_train", "rng", "platform", "dtype_policy")
 
-    def __init__(self, is_train=False, rng=None, platform=None):
+    def __init__(self, is_train=False, rng=None, platform=None,
+                 dtype_policy=None):
         self.is_train = is_train
         self.rng = rng
         self.platform = platform
+        self.dtype_policy = dtype_policy
 
 
 def _parse_bool(v):

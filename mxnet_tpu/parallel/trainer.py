@@ -84,7 +84,8 @@ class Trainer:
                  label_names: Sequence[str] = ("softmax_label",),
                  mesh=None, compute_dtype=None,
                  param_specs: Optional[Dict[str, PartitionSpec]] = None,
-                 remat: Optional[str] = None):
+                 remat: Optional[str] = None,
+                 dtype_policy: Optional[str] = None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -111,6 +112,15 @@ class Trainer:
         import os as _os
         self.remat = remat if remat is not None \
             else _os.environ.get("MXTPU_REMAT", "none")
+        # residual/intermediate dtype policy (op/bytediet.py): the fused
+        # step seeds bf16 cotangents (see ``step``) and the byte-diet
+        # backward formulations keep elementwise math in that dtype with
+        # f32-accumulated reductions; ``"legacy"`` restores the plain
+        # autodiff backwards (A/B and bisection knob,
+        # ``MXTPU_DTYPE_POLICY`` for the process default).
+        self.dtype_policy = dtype_policy if dtype_policy is not None \
+            else _os.environ.get("MXTPU_DTYPE_POLICY", None)
+        self.prog.dtype_policy = self.dtype_policy
         self.param_specs = param_specs or {}
         input_set = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in self.prog.arg_names
@@ -271,11 +281,21 @@ class Trainer:
             if policy is not None:
                 fwd = jax.checkpoint(fwd, policy=policy)
             (outs, new_aux), vjp = jax.vjp(fwd, params)
+            # cotangent seeds in the OUTPUT dtype (bf16 under
+            # compute_dtype): the whole backward chain runs
+            # low-precision elementwise — the byte-diet dtype policy's
+            # cotangent half; its reduction half (f32 accumulation)
+            # lives in the op backward formulations (op/bytediet.py) and
+            # in the f32 master-weight grad cast below
             cot = (tuple(jnp.ones(o.shape, o.dtype) for o in outs),
                    tuple(jnp.zeros(a.shape, a.dtype) for a in new_aux))
             grads = vjp(cot)[0]
             grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
-            new_params, new_state = update_fn(params, grads, opt_state, lr, t)
+            # named scope: the breakdown tool attributes optimizer-state
+            # traffic to this label instead of "(unattributed)"
+            with jax.named_scope("optimizer_update"):
+                new_params, new_state = update_fn(params, grads, opt_state,
+                                                  lr, t)
             # aux (BN moving stats) keep fp32 master copies like params do
             new_aux = tuple(
                 v.astype(jnp.float32)

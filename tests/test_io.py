@@ -677,6 +677,59 @@ def test_device_cache_iter_on_device_normalization():
     np.testing.assert_allclose(got, want, rtol=1e-6)
 
 
+def test_device_cache_iter_legacy_protocol():
+    """The legacy split DataIter protocol (``iter_next()`` then
+    ``getdata()``/``getlabel()``/``getpad()``) observes the SAME batch
+    sequence as ``next()``: ``iter_next`` stages ``current_batch`` like
+    ``DeviceUploadIter`` does.  (Round-5 advisory: previously only the
+    cursor advanced, so the accessors returned the PREVIOUS batch.)"""
+    legacy = io.DeviceCacheIter(_FrameSource(), data_shape=(6, 8),
+                                rand_crop=True, rand_mirror=True,
+                                shuffle=True, seed=5)
+    modern = io.DeviceCacheIter(_FrameSource(), data_shape=(6, 8),
+                                rand_crop=True, rand_mirror=True,
+                                shuffle=True, seed=5)
+    n = 0
+    while legacy.iter_next():
+        want = modern.next()
+        np.testing.assert_array_equal(legacy.getdata()[0].asnumpy(),
+                                      want.data[0].asnumpy())
+        np.testing.assert_array_equal(legacy.getlabel()[0].asnumpy(),
+                                      want.label[0].asnumpy())
+        assert legacy.getpad() == want.pad
+        n += 1
+    with pytest.raises(StopIteration):
+        modern.next()
+    assert n == 3
+    # reset restores both protocols
+    legacy.reset()
+    assert legacy.iter_next()
+    assert legacy.getdata()[0].shape == (8, 6, 8, 3)
+
+
+def test_device_upload_iter_callable_shardings():
+    """Callable shardings resolve lazily, once per staged batch — the
+    hook Module.fit uses so shardings that appear after the wrapper is
+    built (fused-trainer bind) still route uploads (round-5 advisory:
+    a None snapshot staged to the default device and the trainer paid
+    a second device_put per batch)."""
+    resolved = []
+
+    def data_sh():
+        resolved.append(1)
+        return [None]
+
+    x = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)
+    y = np.arange(16, dtype=np.float32)
+    up = io.DeviceUploadIter(io.NDArrayIter(x, y, batch_size=4),
+                             data_shardings=data_sh,
+                             label_shardings=lambda: [None])
+    seen = [b.data[0].asnumpy() for b in up]
+    np.testing.assert_array_equal(np.concatenate(seen, 0), x)
+    assert len(resolved) == 4          # one resolution per staged batch
+    up._shutdown_worker()
+
+
 def test_device_cache_iter_shards_with_num_parts(tmp_path):
     """The docs' pod recipe: each worker caches only ITS num_parts
     shard — two part caches are disjoint and together cover the set."""

@@ -310,6 +310,23 @@ G("Deconvolution",
 G("Pooling", {"data": distinct(1, 2, 4, 4)},
   {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"},
   id_suffix="max")
+# OVERLAPPING windows (kernel > stride — the ResNet stem geometry):
+# exercises the byte-diet argmax-index backward where one input
+# position feeds several windows (op/bytediet.py).  eps=1e-2: pooling
+# is piecewise linear (distinct() separates values by 0.37, no argmax
+# flip) and a 1e-3 central difference of the ~1e2-magnitude f32 loss
+# is quantization-limited (ULP ~1.5e-5 vs a ~3e-4 numerator).
+# R-state save/restore: keep the shared stream unchanged for every
+# later case (their data — and borderline lowp tolerances — must not
+# depend on cases inserted above them)
+_R_STATE = R.get_state()
+G("Pooling", {"data": distinct(1, 2, 5, 5)},
+  {"kernel": (3, 3), "stride": (2, 2), "pad": (1, 1),
+   "pool_type": "max"}, id_suffix="max-overlap", eps=1e-2)
+G("Pooling", {"data": distinct(1, 5, 5, 2)},
+  {"kernel": (3, 3), "stride": (2, 2), "pool_type": "max",
+   "layout": "NHWC"}, id_suffix="max-nhwc", eps=1e-2)
+R.set_state(_R_STATE)
 G("Pooling", {"data": randn(1, 2, 4, 4)},
   {"kernel": (2, 2), "stride": (2, 2), "pool_type": "avg"},
   id_suffix="avg")
@@ -323,6 +340,16 @@ G("BatchNorm",
   {"data": randn(2, 3, 2, 2), "gamma": pos(3), "beta": randn(3)},
   aux={"moving_mean": np.zeros(3, "f"), "moving_var": np.ones(3, "f")},
   rtol=8e-2, atol=2e-2)
+# channels-last (the fused ResNet path's axis=3): exercises the
+# byte-diet fused BN backward over NHWC reduce axes (R-state
+# save/restore as above: later cases keep their original data)
+_R_STATE = R.get_state()
+G("BatchNorm",
+  {"data": randn(2, 2, 2, 3), "gamma": pos(3), "beta": randn(3)},
+  {"axis": 3},
+  aux={"moving_mean": np.zeros(3, "f"), "moving_var": np.ones(3, "f")},
+  rtol=8e-2, atol=2e-2, id_suffix="nhwc")
+R.set_state(_R_STATE)
 G("InstanceNorm",
   {"data": randn(2, 3, 4, 4), "gamma": pos(3), "beta": randn(3)},
   rtol=8e-2, atol=2e-2)

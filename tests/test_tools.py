@@ -89,6 +89,64 @@ def test_gen_op_docs(tmp_path):
     assert "**required**" in text
 
 
+def test_step_breakdown_budget_and_layers(tmp_path):
+    """tools/step_breakdown.py round-6 surface, sans the ResNet compile:
+    symbol-layer attribution parses named-scope ``op_name`` metadata out
+    of real compiled HLO, and the byte-budget emit → parse → gate cycle
+    round-trips (the machinery behind the nightly ``--check`` gate and
+    bench.py's ``byte_budget_*`` fields)."""
+    import json
+    import jax
+    import jax.numpy as jnp
+    from tools import step_breakdown as sb
+
+    # op_name grammar: jvp-wrapped forward, transpose(jvp()) backward,
+    # scope-less wrapper-only paths
+    assert sb.layer_from_op_name("jit(step)/jvp(conv0)/max") == \
+        ("conv0", False)
+    assert sb.layer_from_op_name(
+        "jit(step)/transpose(jvp(stage1_relu))/mul") == ("stage1_relu", True)
+    assert sb.layer_from_op_name("jit(f)/add")[0] is None
+
+    # attribution over REAL compiled HLO (executor.py stamps the same
+    # per-symbol-node scopes the fused step carries)
+    def f(x):
+        with jax.named_scope("conv0"):
+            y = jnp.maximum(x, 0.0)
+        with jax.named_scope("fc1"):
+            return (y * 2.0).sum()
+
+    comp = jax.jit(jax.grad(f)).lower(jnp.ones((256, 256))).compile()
+    rows = sb.analyze(comp.as_text(), hbm_gbps=600.0, mxu_tflops=180.0)
+    layers = sb.layer_table(rows)
+    assert any(k.split(" ")[0] in ("conv0", "fc1") for k in layers), layers
+    assert sum(e["n_instructions"] for e in layers.values()) == len(rows)
+
+    # budget: emit -> parse -> gate (ok inside tolerance, fail outside)
+    entry = sb.byte_budget_entry(
+        {"model": "toy", "cost_model_gb_per_step": 10.0})
+    path = str(tmp_path / "budget.json")
+    json.dump({"tolerance_pct": 3.0, "cpu": entry}, open(path, "w"))
+    budget = sb.load_budget(path)
+    ok, delta = sb.check_byte_budget(10.1, budget["cpu"],
+                                     budget["tolerance_pct"])
+    assert ok and abs(delta - 1.0) < 0.2
+    ok, delta = sb.check_byte_budget(10.4, budget["cpu"],
+                                     budget["tolerance_pct"])
+    assert not ok and delta > 3.0
+
+    # the checked-in budget file parses and carries the gate's fields
+    budget = sb.load_budget()
+    assert budget and "tolerance_pct" in budget
+    for plat in ("tpu", "cpu"):
+        assert "cost_model_gb_per_step" in budget[plat]
+        # run_check refuses to gate against a wrong-shape entry (a
+        # full-shape capture recorded into the small-shape CPU slot
+        # would leave the gate ~95% slack): every entry must carry the
+        # model string the guard compares
+        assert "model" in budget[plat]
+
+
 def test_attn_bench_smoke(tmp_path):
     """tools/attn_bench.py runs end-to-end at toy size (flash in
     interpret mode on CPU) and writes a well-formed artifact."""
