@@ -374,6 +374,27 @@ def main():
         if roof.get("hbm_gbps"):
             line["hbm_frac_upper_bound"] = round(
                 byts * (img_s / batch) / 1e9 / roof["hbm_gbps"], 3)
+        # trace-time lint finding counts alongside the byte accounting
+        # (the CI gate is `tools/graph_lint.py --check`; this line keeps
+        # the hazard counts next to cost_model_gb_per_step so a byte
+        # regression and a new lint hazard are read together —
+        # docs/how_to/graph_lint.md).  Own except like the budget diff.
+        try:
+            from mxnet_tpu import analysis
+            lint_sym = analysis.lint_symbol(
+                mod._symbol,
+                shapes={"data": (batch, image, image, 3),
+                        "softmax_label": (batch,)},
+                trace=False, model="resnet-50")
+            lint_step = mod._trainer.lint()
+            counts = lint_sym.counts()
+            for sev, n in lint_step.counts().items():
+                counts[sev] += n
+            line["lint_findings"] = counts
+            line["lint_errors_by_rule"] = dict(
+                lint_sym.by_rule("error"), **lint_step.by_rule("error"))
+        except Exception as e:                      # noqa: BLE001
+            line["lint_error"] = str(e)
         # byte-budget diff (informational here; the nightly tier gates
         # via `tools/step_breakdown.py --check` — docs/how_to/perf.md
         # "Byte diet").  Own except: a malformed budget file must not

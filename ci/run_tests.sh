@@ -122,6 +122,13 @@ else
 fi
 
 # ---------------------------------------------------------------- cpu lanes
+stage "graph lint gate (trace-time, no device execution)"
+# static shape/dtype/TPU-hazard analysis over the bench symbol graphs
+# and their fwd+bwd jaxprs; FAILS on NEW error-severity findings vs the
+# checked-in LINT_BASELINE.json (ratchet with --write-baseline) and
+# prints the finding summary — docs/how_to/graph_lint.md
+python tools/graph_lint.py --check
+
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \

@@ -116,6 +116,7 @@ from . import rtc
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import parallel
+from . import analysis
 
 # Custom op front-ends (reference mx.nd.Custom / mx.sym.Custom)
 ndarray.Custom = operator._custom_entry("nd")

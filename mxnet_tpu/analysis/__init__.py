@@ -1,0 +1,37 @@
+"""Trace-time graph linter: static shape/dtype/TPU-hazard analysis over
+the symbol graph and the jitted jaxpr.
+
+Two pass families (``docs/how_to/graph_lint.md`` has the rule catalog):
+
+* **symbol-level** (pre-bind): whole-graph shape/dtype inference with
+  per-node conflict diagnostics, dead-code and unused-argument
+  detection, duplicate-subgraph (CSE) reporting, TPU (8, 128) layout
+  hazards, f64 promotion creep.
+* **jaxpr-level** (``jax.make_jaxpr`` over the graph program or the
+  fused trainer step): f64 widening, host callbacks / device_put inside
+  the step, non-donated state buffers, unfused gather/scatter — each
+  attributed to its symbol layer via the executor's ``named_scope``.
+
+CLI: ``tools/graph_lint.py`` (``--check`` gates CI against
+``LINT_BASELINE.json``).  Custom passes: subclass
+:class:`~.core.GraphPass` and decorate with
+:func:`~.core.register_pass`.
+"""
+from .core import (ERROR, INFO, WARN, SEVERITIES, Annotation, Finding,
+                   GraphLintWarning, GraphPass, GraphView, LintReport,
+                   NodeView, PassContext, annotate, get_pass, list_passes,
+                   register_pass, run_passes)
+from .lint import lint_json, lint_symbol, lint_trainer
+from . import symbol_passes  # noqa: F401  registers the symbol passes
+from . import jaxpr_passes   # noqa: F401  registers the jaxpr passes
+from .baseline import (BASELINE_PATH, baseline_entry, check_baseline,
+                       load_baseline, write_baseline)
+
+__all__ = [
+    "ERROR", "WARN", "INFO", "SEVERITIES", "Annotation", "Finding",
+    "GraphLintWarning", "GraphPass", "GraphView", "LintReport", "NodeView",
+    "PassContext", "annotate", "get_pass", "list_passes", "register_pass",
+    "run_passes", "lint_symbol", "lint_json", "lint_trainer",
+    "BASELINE_PATH", "baseline_entry", "check_baseline", "load_baseline",
+    "write_baseline", "symbol_passes", "jaxpr_passes",
+]

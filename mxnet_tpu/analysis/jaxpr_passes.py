@@ -1,0 +1,291 @@
+"""Jaxpr-level lint passes: hazards the symbol graph can't see.
+
+The traced program (``jax.make_jaxpr`` over the ``_GraphProgram`` body,
+or over the Trainer's fused step) exposes what autodiff and the op
+bodies actually emit: dtype widenings, host callbacks, buffer-donation
+gaps, unfused gather/scatter.  Findings are attributed back to symbol
+layers through each equation's name stack — the same per-node
+``jax.named_scope`` the executor stamps for
+``tools/step_breakdown.py``'s HBM byte attribution, so lint provenance
+and byte attribution agree.
+"""
+from __future__ import annotations
+
+import re
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from .core import (ERROR, INFO, WARN, Finding, GraphPass, PassContext,
+                   register_pass)
+
+__all__ = ["iter_eqns", "layer_of_eqn", "F64WideningPass",
+           "HostCallbackPass", "DonationPass", "GatherScatterPass"]
+
+_SCOPE_RE = re.compile(r"^(transpose\()?(?:jvp\()?([A-Za-z0-9_.\-]+?)\)*$")
+
+
+def layer_of_eqn(eqn) -> Tuple[Optional[str], bool]:
+    """``(symbol_layer, is_backward)`` from an equation's name stack.
+
+    The executor's per-node ``jax.named_scope`` leaves the symbol node
+    name as a stack component — plain (``conv0``), or autodiff-wrapped:
+    ``jvp(conv0)`` forward, ``transpose(jvp(conv0))`` backward.  Deepest
+    symbol scope wins (mirrors ``step_breakdown.layer_from_op_name``,
+    which parses the same stack out of XLA instruction metadata).
+    """
+    try:
+        stack = str(eqn.source_info.name_stack)
+    except Exception:  # pragma: no cover - older jax layouts
+        return None, False
+    layer, bwd = None, False
+    for part in stack.split("/"):
+        if "(" in part and not part.startswith(("transpose(", "jvp(")):
+            continue                       # jit(...)/pjit wrappers
+        m = _SCOPE_RE.match(part)
+        if m and m.group(2):
+            layer = m.group(2)
+            bwd = bwd or bool(m.group(1))
+    return layer, bwd
+
+
+def _is_f64(dt) -> bool:
+    """True for float64, tolerating extended dtypes (PRNG key avals)
+    numpy cannot interpret."""
+    try:
+        return np.dtype(dt) == np.dtype(np.float64)
+    except TypeError:
+        return False
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        if hasattr(v, "eqns"):                       # Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # Closed
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for w in v:
+                if hasattr(w, "eqns"):
+                    yield w
+                elif hasattr(w, "jaxpr") and hasattr(w.jaxpr, "eqns"):
+                    yield w.jaxpr
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every equation of a (Closed)Jaxpr, recursing through nested
+    call/pjit/custom-vjp/scan bodies."""
+    jx = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jx.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            for e in iter_eqns(sub):
+                yield e
+
+
+def _where(eqn):
+    layer, bwd = layer_of_eqn(eqn)
+    if layer is None:
+        return None, "(unattributed)"
+    return layer, layer + (" (bwd)" if bwd else "")
+
+
+@register_pass
+class F64WideningPass(GraphPass):
+    """``convert_element_type`` widening to f64 inside the step.
+
+    The symbol-level dtype pass sees declared dtypes; this one sees what
+    the trace actually emits — np.float64 scalars leaking in through op
+    params, weak-type promotion inside an op body, a stray
+    ``astype(float)``.  One finding per (layer, primitive) so a single
+    leak doesn't spam per-equation.
+    """
+
+    name = "f64-widening"
+    level = "jaxpr"
+
+    def run(self, ctx: PassContext):
+        if ctx.jaxpr is None:
+            return []
+        out, seen = [], set()
+        f64 = np.dtype(np.float64)
+        for eqn in iter_eqns(ctx.jaxpr):
+            hit = None
+            if eqn.primitive.name == "convert_element_type" \
+                    and _is_f64(eqn.params.get("new_dtype", np.float32)):
+                hit = "convert_element_type widens to float64"
+            elif any(_is_f64(getattr(v.aval, "dtype", np.float32))
+                     for v in eqn.outvars if hasattr(v.aval, "dtype")) \
+                    and not any(
+                        _is_f64(getattr(v.aval, "dtype", np.float32))
+                        for v in eqn.invars if hasattr(v, "aval")
+                        and hasattr(v.aval, "dtype")):
+                hit = "%s produces float64 from non-f64 inputs" \
+                    % eqn.primitive.name
+            if hit is None:
+                continue
+            layer, where = _where(eqn)
+            key = (where, eqn.primitive.name)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(
+                self.name, ERROR, where, eqn.primitive.name,
+                "%s inside the jitted step (TPU emulates f64 at >10x "
+                "slowdown)" % hit, layer=layer,
+                detail={"outvars": [str(v.aval) for v in eqn.outvars][:4]}))
+        return out
+
+
+_CALLBACK_PRIMS = {"io_callback", "pure_callback", "debug_callback",
+                   "callback", "outside_call", "host_callback_call"}
+
+
+@register_pass
+class HostCallbackPass(GraphPass):
+    """Host callbacks / device_put inside the jitted step.
+
+    A callback stalls the step on a host round trip every invocation —
+    on a tunneled chip that is milliseconds of dead time per step; a
+    ``device_put`` inside the trace forces a placed copy where the
+    sharding propagation should have decided placement (the executor's
+    ``group2ctx`` path inserts them deliberately, which is why this is
+    warn, not error, for device_put).
+    """
+
+    name = "host-callback"
+    level = "jaxpr"
+
+    def run(self, ctx: PassContext):
+        if ctx.jaxpr is None:
+            return []
+        out, seen = [], set()
+        for eqn in iter_eqns(ctx.jaxpr):
+            pname = eqn.primitive.name
+            if pname in _CALLBACK_PRIMS:
+                sev, msg = ERROR, ("host callback %r inside the jitted "
+                                   "step: one host round trip per step"
+                                   % pname)
+            elif pname == "device_put":
+                sev, msg = WARN, ("device_put inside the jitted step "
+                                  "forces placement mid-program")
+            else:
+                continue
+            layer, where = _where(eqn)
+            key = (where, pname)
+            if key in seen:
+                continue
+            seen.add(key)
+            out.append(Finding(self.name, sev, where, pname, msg,
+                               layer=layer))
+        return out
+
+
+@register_pass
+class DonationPass(GraphPass):
+    """Large persistent-state buffers not donated to the step.
+
+    The fused trainer step (``parallel/trainer.py``) donates params,
+    aux, and optimizer state so updates are in-place HBM writes; a
+    non-donated state buffer doubles its HBM footprint and forces a
+    copy.  Runs only when the caller supplied donation metadata (the
+    pjit ``donated_invars`` plus a pytree-path label per invar); batch
+    inputs are exempt — they are fresh every step by design.
+    """
+
+    name = "donation"
+    level = "jaxpr"
+
+    _STATE = ("params", "aux", "opt_state")
+
+    def run(self, ctx: PassContext):
+        if ctx.jaxpr is None or ctx.donated_invars is None \
+                or ctx.invar_labels is None:
+            return []
+        min_bytes = int(ctx.config.get("donation_min_bytes", 1 << 20))
+        jx = getattr(ctx.jaxpr, "jaxpr", ctx.jaxpr)
+        out = []
+        offenders = []
+        total = 0
+        for var, donated, label in zip(jx.invars, ctx.donated_invars,
+                                       ctx.invar_labels):
+            if donated or not label.startswith(self._STATE):
+                continue
+            aval = getattr(var, "aval", None)
+            if aval is None or not hasattr(aval, "dtype"):
+                continue
+            try:
+                itemsize = np.dtype(aval.dtype).itemsize
+            except TypeError:       # extended dtypes (PRNG keys)
+                continue
+            nbytes = int(np.prod(aval.shape or (1,)) * itemsize)
+            if nbytes >= min_bytes:
+                offenders.append((label, nbytes))
+                total += nbytes
+        if offenders:
+            offenders.sort(key=lambda kv: -kv[1])
+            out.append(Finding(
+                self.name, WARN, "<step>", "pjit",
+                "%d state buffer(s) totalling %.1f MB are not donated "
+                "(doubled HBM footprint + copy per step): %s"
+                % (len(offenders), total / 1e6,
+                   ", ".join("%s (%.1f MB)" % (l, b / 1e6)
+                             for l, b in offenders[:5])),
+                detail={"offenders": [l for l, _ in offenders]}))
+        return out
+
+
+@register_pass
+class GatherScatterPass(GraphPass):
+    """Unfused gather/scatter families in the step.
+
+    ``select_and_scatter_add`` is the autodiff MaxPool backward the
+    byte-diet (PR 1, ``op/bytediet.py``) replaced with an
+    argmax-index scatter-add — its presence means a pooling op fell off
+    the byte-diet path (warn, unless the policy is explicitly
+    ``legacy``).  Plain gather/scatter are legitimate (embeddings,
+    byte-diet pool backward) and are reported as info counts per layer
+    so the byte attribution in ``tools/step_breakdown.py`` has a
+    trace-time cross-check.
+    """
+
+    name = "gather-scatter"
+    level = "jaxpr"
+
+    def run(self, ctx: PassContext):
+        if ctx.jaxpr is None:
+            return []
+        out = []
+        sns_layers = []
+        counts = {}
+        for eqn in iter_eqns(ctx.jaxpr):
+            pname = eqn.primitive.name
+            if pname in ("select_and_scatter_add", "select_and_scatter"):
+                _, where = _where(eqn)
+                sns_layers.append(where)
+            elif pname in ("gather", "scatter", "scatter-add",
+                           "scatter_add"):
+                _, where = _where(eqn)
+                counts[where] = counts.get(where, 0) + 1
+        # resolve the EFFECTIVE policy the traced op bodies used: an
+        # unset ctx value falls back to the process default
+        # (MXTPU_DTYPE_POLICY), exactly like OpContext resolution does
+        from ..op import bytediet
+        policy = ctx.dtype_policy or bytediet.default_policy()
+        if sns_layers and policy != "legacy":
+            out.append(Finding(
+                self.name, WARN, sns_layers[0], "select_and_scatter_add",
+                "%d select_and_scatter in the step (layers %s): the "
+                "byte-diet argmax-index pool backward should have "
+                "eliminated these — a pooling op fell off the bytediet "
+                "path" % (len(sns_layers), sorted(set(sns_layers))[:4]),
+                detail={"layers": sorted(set(sns_layers))}))
+        if counts:
+            total = sum(counts.values())
+            top = sorted(counts.items(), key=lambda kv: -kv[1])
+            out.append(Finding(
+                self.name, INFO, top[0][0], "gather/scatter",
+                "%d gather/scatter eqns in the step: %s" %
+                (total, ", ".join("%s x%d" % kv for kv in top[:5])),
+                detail={"counts": counts}))
+        return out

@@ -177,6 +177,8 @@ class Executor:
         self._outputs: List[NDArray] = []
         self._vjp = None
         self._monitor = None
+        self._lint_report = None   # set by simple_bind's lint hook
+        self._debug_ann = None     # cached analyzer annotation
         self._const_key = None      # cached rng key for rng-free programs
         self._const_key_dev = None
         self._partial = None      # partial_forward's carried env
@@ -413,16 +415,59 @@ class Executor:
         bulk exec)."""
         self._monitor = callback
 
+    def _annotation(self):
+        """The analyzer's annotated graph (per-node inferred
+        shape/dtype) for this executor's bound shapes — computed lazily,
+        shared between ``debug_str`` and lint provenance so the two
+        always agree."""
+        if self._debug_ann is not None:
+            return self._debug_ann or None   # False = sticky failure
+        rep = self._lint_report
+        if rep is not None and rep.annotation is not None:
+            self._debug_ann = rep.annotation
+            return self._debug_ann
+        try:
+            from . import analysis
+            view = analysis.GraphView.from_symbol(self._symbol)
+            ann, _ = analysis.annotate(
+                view,
+                shapes={n: tuple(a.shape) for n, a in self.arg_dict.items()},
+                dtypes={n: a.dtype for n, a in self.arg_dict.items()})
+            self._debug_ann = ann
+        except Exception:  # noqa: BLE001 — debug output must never raise
+            self._debug_ann = False   # don't re-walk the graph per call
+            return None
+        return self._debug_ann
+
     def debug_str(self):
         lines = ["Symbol outputs: %s" % ", ".join(self._symbol.list_outputs())]
-        for n in self._prog.nodes:
+        ann = self._annotation()
+
+        def _sd(idx, n_out=1):
+            if ann is None:
+                return ""
+            outs = []
+            for i in range(n_out):
+                s = ann.shape.get((idx, i))
+                t = ann.dtype.get((idx, i))
+                outs.append("%s %s" % (t if t is not None else "?",
+                                       s if s is not None else "?"))
+            return ", out=[%s]" % "; ".join(outs)
+
+        # GraphView.from_symbol enumerates the same _topo order as
+        # self._prog.nodes, so positional index IS the annotation key
+        for i, n in enumerate(self._prog.nodes):
             if n.is_variable:
-                lines.append("Variable:%s" % n.name)
+                lines.append("Variable:%s%s" % (n.name, _sd(i)))
             else:
                 where = self._prog.placement.get(n.name)
-                lines.append("Op:%s, Name=%s%s" % (
-                    n.op.name, n.name,
+                lines.append("Op:%s, Name=%s%s%s" % (
+                    n.op.name, n.name, _sd(i, n.num_outputs()),
                     ", Device=%s" % where if where is not None else ""))
+        if self._lint_report is not None and self._lint_report.findings:
+            lines.append("Graph lint findings:")
+            for f in self._lint_report.findings:
+                lines.append("  " + f.format())
         return "\n".join(lines)
 
 
@@ -443,15 +488,28 @@ def bind(sym, ctx, args, args_grad=None, grad_req="write", aux_states=None,
 
 
 def simple_bind(sym, ctx=None, grad_req="write", type_dict=None,
-                group2ctx=None, shared_exec=None, **kwargs):
+                group2ctx=None, shared_exec=None, _graph_lint=True,
+                **kwargs):
     ctx = ctx or current_context()
-    arg_shapes, _, aux_shapes = sym.infer_shape(**kwargs)
-    if arg_shapes is None:
-        raise MXNetError("cannot infer shapes from %s" % kwargs)
     type_dict = type_dict or {}
-    arg_types, _, aux_types = sym.infer_type(**type_dict)
     arg_names = sym.list_arguments()
     aux_names = sym.list_auxiliary_states()
+    # lint first: the analyzer's annotation walk IS a full shape+dtype
+    # inference, so when it resolves cleanly the bind reuses it and
+    # pays ONE inference walk total (lint included) instead of the
+    # separate infer_shape + infer_type passes
+    report = _lint_at_bind(sym, kwargs, type_dict) if _graph_lint else None
+    shapes_types = report and _shapes_from_annotation(
+        report, arg_names, aux_names)
+    if shapes_types is not None:
+        arg_shapes, arg_types, aux_shapes, aux_types = shapes_types
+    else:
+        # canonical inference path: raises the canonical MXNetErrors
+        # for unresolvable/conflicting graphs (also the lint-off path)
+        arg_shapes, _, aux_shapes = sym.infer_shape(**kwargs)
+        if arg_shapes is None:
+            raise MXNetError("cannot infer shapes from %s" % kwargs)
+        arg_types, _, aux_types = sym.infer_type(**type_dict)
     args = {n: zeros(s, ctx, t or np.float32)
             for n, s, t in zip(arg_names, arg_shapes, arg_types)}
     if isinstance(grad_req, dict):
@@ -465,7 +523,53 @@ def simple_bind(sym, ctx=None, grad_req="write", type_dict=None,
                  if reqs.get(n, "null") != "null"}
     aux_states = {n: zeros(s, ctx, t or np.float32)
                   for n, s, t in zip(aux_names, aux_shapes, aux_types)}
-    return Executor(sym, ctx, args, args_grad, grad_req, aux_states, group2ctx)
+    exe = Executor(sym, ctx, args, args_grad, grad_req, aux_states, group2ctx)
+    if report is not None:
+        exe._lint_report = report
+    return exe
+
+
+def _shapes_from_annotation(report, arg_names, aux_names):
+    """Arg/aux shapes+dtypes out of a clean lint annotation; None when
+    any entry is unresolved (or the lint found errors) — the caller
+    then re-runs canonical inference for its canonical exceptions."""
+    ann = report.annotation
+    if ann is None or report.errors():
+        return None
+    if any(ann.var_shape.get(n) is None for n in arg_names) \
+            or any(ann.aux_shape.get(n) is None for n in aux_names):
+        return None
+    return ([ann.var_shape[n] for n in arg_names],
+            [ann.var_dtype.get(n) for n in arg_names],
+            [ann.aux_shape[n] for n in aux_names],
+            [ann.aux_dtype.get(n) for n in aux_names])
+
+
+def _lint_at_bind(sym, shapes, dtypes):
+    """Symbol-level lint at ``simple_bind`` time: surfaces findings as
+    a GraphLintWarning and returns the report (whose annotation the
+    bind reuses for allocation).  ``MXTPU_GRAPH_LINT=0`` disables."""
+    import os
+    if os.environ.get("MXTPU_GRAPH_LINT", "1") == "0":
+        return None
+    try:
+        from . import analysis
+        report = analysis.lint_symbol(sym, shapes=shapes, dtypes=dtypes,
+                                      trace=False)
+    except Exception:  # noqa: BLE001 — lint must never break binding
+        return None
+    c = report.counts()
+    if c["error"] or c["warn"]:
+        import warnings
+        worst = (report.errors() or report.warnings())[0]
+        warnings.warn(
+            "graph lint: %d error / %d warn finding(s), e.g. %s  "
+            "(Executor.debug_str() lists all; MXTPU_GRAPH_LINT=0 "
+            "disables)" % (c["error"], c["warn"], worst.format()),
+            # _lint_at_bind -> executor.simple_bind -> Symbol.simple_bind
+            # -> the USER's bind call, which the warning should name
+            analysis.GraphLintWarning, stacklevel=4)
+    return report
 
 
 def _to_dict(arrays, names, what, allow_partial=False, allow_missing=False):

@@ -175,7 +175,10 @@ class DataParallelExecutorGroup(object):
         shared_exec = shared_group.execs[i] if shared_group is not None else None
         executor = self.symbol.simple_bind(
             ctx=ctx, grad_req=self.grad_req, type_dict=input_types,
-            shared_exec=shared_exec, **input_shapes)
+            shared_exec=shared_exec,
+            # the per-device binds are shape-identical modulo the batch
+            # slice: lint (and warn) once, on the first executor
+            _graph_lint=(i == 0), **input_shapes)
         return executor
 
     # ------------------------------------------------------------------

@@ -103,10 +103,14 @@ _reg_sampler(
 
 
 def _neg_binomial(p, c):
-    # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p)).  The gamma draw carries an
+    # EXPLICIT f32 dtype: jax.random.gamma's default is x64-dependent,
+    # so a dtype-less draw silently computes in f64 on an x64-enabled
+    # process (and trips the f64-widening lint's x64 trace).
     k, prob = p["k"], p["p"]
     k1, k2 = jax.random.split(c.rng)
-    lam = jax.random.gamma(k1, k, p["shape"] or (1,)) * ((1.0 - prob) / prob)
+    lam = jax.random.gamma(k1, k, p["shape"] or (1,), jnp.float32) \
+        * ((1.0 - prob) / prob)
     return jax.random.poisson(k2, lam).astype(p["dtype"])
 
 
@@ -120,7 +124,8 @@ def _gen_neg_binomial(p, c):
     k = 1.0 / alpha
     prob = k / (k + mu)
     k1, k2 = jax.random.split(c.rng)
-    lam = jax.random.gamma(k1, k, p["shape"] or (1,)) * ((1.0 - prob) / prob)
+    lam = jax.random.gamma(k1, k, p["shape"] or (1,), jnp.float32) \
+        * ((1.0 - prob) / prob)
     return jax.random.poisson(k2, lam).astype(p["dtype"])
 
 

@@ -187,8 +187,21 @@ class Op:
         for k, v in kwargs.items():
             if k in spec:
                 params[k] = spec[k].coerce(v)
+            elif k.startswith("__") and k.endswith("__"):
+                # escape hatch: dunder group attrs (__lr_mult__ and kin)
+                # ride through untouched — op bodies never read them,
+                # but serialization keeps them with the node
+                params[k] = v
             else:
-                raise MXNetError("%s got unknown parameter %r" % (self.name, k))
+                # typo'd kwargs silently dropping is the classic MXNet
+                # footgun (reference dmlc::Parameter ignores unknown
+                # keys); reject with a did-you-mean
+                import difflib
+                close = difflib.get_close_matches(k, spec, n=1)
+                hint = "; did you mean %r?" % close[0] if close else ""
+                raise MXNetError(
+                    "%s got unknown parameter %r%s (known parameters: %s)"
+                    % (self.name, k, hint, sorted(spec) or "none"))
         for p in self.params_spec:
             if p.name not in params:
                 if p.required:
@@ -265,9 +278,14 @@ class Op:
         known = [d for d in in_dtypes if d is not None]
         dt = known[0] if known else np.dtype(np.float32)
         in_dtypes = [d if d is not None else dt for d in in_dtypes]
+        # an explicit ``dtype`` param (Cast, creation ops, samplers)
+        # DEFINES the output dtype; propagating the input dtype instead
+        # hid every Cast from type inference (and from the f64 lint)
+        out_dt = params.get("dtype") if params else None
+        out_dt = np.dtype(out_dt) if out_dt is not None else dt
         n_out = self.n_outputs(params)
         n_aux = len(self.list_aux(params))
-        return in_dtypes, [dt] * n_out, [dt] * n_aux
+        return in_dtypes, [out_dt] * n_out, [out_dt] * n_aux
 
 
 def register(name, fn=None, **kwargs) -> Callable:

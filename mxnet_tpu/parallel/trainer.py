@@ -403,6 +403,20 @@ class Trainer:
                                    self._key)
         return [NDArray(self._local_rows(o)) for o in outs]
 
+    def lint(self, config: Optional[Dict] = None,
+             input_dtypes: Optional[Dict] = None):
+        """Trace-time lint of the fused step: re-trace ``_step_fn`` to
+        its pjit jaxpr and run the jaxpr-level hazard passes (f64
+        widening, host callbacks, non-donated state buffers, unfused
+        gather/scatter), each finding attributed to its symbol layer via
+        the per-node named scopes.  Pure ``jax.make_jaxpr`` — no device
+        execution.  Pass ``input_dtypes`` (name -> dtype) for int-token
+        or uint8-pipeline inputs so the trace matches the real step.
+        Returns an ``analysis.LintReport``."""
+        from .. import analysis
+        return analysis.lint_trainer(self, config=config,
+                                     input_dtypes=input_dtypes)
+
     def get_opt_states(self) -> bytes:
         """Serialize (num_update, optimizer state pytree) — the fused
         analog of ``Updater.get_states`` (reference ``optimizer.py``)."""

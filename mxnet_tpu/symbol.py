@@ -52,18 +52,33 @@ class _Node:
 
 def _topo(nodes_out: Sequence[_Node]) -> List[_Node]:
     # iterative post-order: graph depth must not be bounded by the
-    # Python recursion limit (a 1000+-layer sequential net is legal)
+    # Python recursion limit (a 1000+-layer sequential net is legal).
+    # A node re-encountered while still gray (expanded but not emitted)
+    # is reachable from its own descendants — a cycle; silently skipping
+    # it would emit a wrong order and fail far away inside inference.
     seen = set()
+    gray = {}            # id -> node, expanded but not yet emitted
     order = []
     stack = [(n, False) for n in reversed(nodes_out)]
     while stack:
         node, expanded = stack.pop()
         if expanded:
             order.append(node)
+            gray.pop(id(node), None)
             continue
         if id(node) in seen:
+            if id(node) in gray:
+                cyc = sorted(g.name for g in gray.values())
+                raise MXNetError(
+                    "cycle detected in symbol graph at node %r%s; "
+                    "nodes on the cycle path: %s"
+                    % (node.name,
+                       "" if node.is_variable
+                       else " (op %s)" % node.op.name,
+                       cyc[:8]))
             continue
         seen.add(id(node))
+        gray[id(node)] = node
         stack.append((node, True))
         for child, _ in reversed(node.inputs):
             stack.append((child, False))

@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Trace-time graph linter CLI.
+
+Runs the ``mxnet_tpu.analysis`` pass pipeline — whole-graph shape/dtype
+inference with per-node diagnostics, dead-code / duplicate-subgraph /
+TPU-layout / f64-promotion symbol passes, then ``jax.make_jaxpr`` over
+the train program for the jaxpr-level hazards (f64 widening, host
+callbacks, non-donated buffers, unfused gather/scatter) — on:
+
+  * serialized symbol JSON files passed as arguments, or
+  * the bench models (ResNet-50 NHWC at the bench shape + the
+    transformer LM) when called with no files.
+
+Everything is pure trace time (no device execution), so the gate runs
+in the fast CI tier.  ``--check`` diffs error-severity findings against
+the checked-in ``LINT_BASELINE.json`` and exits non-zero on NEW errors
+(the ``STEP_BYTE_BUDGET.json`` ratchet pattern — see
+``tools/step_breakdown.py``); ``--write-baseline`` re-records after an
+intentional change.  Rule catalog: ``docs/how_to/graph_lint.md``.
+"""
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def bench_targets():
+    """The two gated bench graphs at their canonical shapes.  Trace
+    cost is shape-independent (abstract evaluation), so the full bench
+    shapes are used even on CPU-only hosts."""
+    from mxnet_tpu import models
+    return {
+        "resnet-50": dict(
+            sym=models.get_symbol("resnet-50", num_classes=1000,
+                                  layout="NHWC"),
+            shapes={"data": (256, 224, 224, 3), "softmax_label": (256,)},
+            dtypes=None),
+        "transformer": dict(
+            sym=models.get_symbol("transformer", num_classes=1000,
+                                  seq_len=128, num_hidden=256, num_heads=4),
+            shapes={"data": (8, 128), "softmax_label": (8, 128)},
+            dtypes={"data": np.int32}),
+    }
+
+
+def _parse_shapes(specs):
+    """--shape name=(1,224,224,3) pairs -> dict."""
+    import ast
+    out = {}
+    for spec in specs or []:
+        name, _, val = spec.partition("=")
+        if not val:
+            raise SystemExit("--shape expects name=(d0,d1,...), got %r"
+                             % spec)
+        v = ast.literal_eval(val)
+        out[name] = tuple(v) if isinstance(v, (tuple, list)) else (int(v),)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("graphs", nargs="*",
+                    help="symbol JSON files to lint (default: the bench "
+                         "ResNet-50 and transformer graphs)")
+    ap.add_argument("--model", action="append", default=None,
+                    help="bench model name(s) to lint instead of all "
+                         "(resnet-50, transformer)")
+    ap.add_argument("--shape", action="append", default=None,
+                    metavar="NAME=(D0,D1,...)",
+                    help="input shape for JSON graphs (repeatable)")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="symbol-level passes only (skip jax.make_jaxpr)")
+    ap.add_argument("--eval", action="store_true",
+                    help="trace the eval program instead of fwd+bwd")
+    ap.add_argument("--policy", default=None,
+                    help="dtype policy for the trace (bytediet|legacy)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate NEW error findings against %s"
+                         % os.path.basename("LINT_BASELINE.json"))
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings into the baseline "
+                         "(ratchet after an intentional change)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full reports as one JSON object")
+    ap.add_argument("--max-findings", type=int, default=25,
+                    help="findings printed per graph (default 25)")
+    args = ap.parse_args(argv)
+
+    # trace-time only: keep the gate off the chip (and off the tunnel)
+    # unless the caller explicitly wants a platform
+    if "MXTPU_LINT_PLATFORM" not in os.environ:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    from mxnet_tpu import analysis
+
+    reports = {}
+    if args.graphs:
+        shapes = _parse_shapes(args.shape)
+        for path in args.graphs:
+            with open(path) as f:
+                txt = f.read()
+            name = os.path.basename(path)
+            reports[name] = analysis.lint_json(
+                txt, shapes=shapes or None, trace=not args.no_trace,
+                is_train=not args.eval, dtype_policy=args.policy,
+                model=name)
+    else:
+        targets = bench_targets()
+        names = args.model or sorted(targets)
+        for name in names:
+            if name not in targets:
+                raise SystemExit("unknown bench model %r (have %s)"
+                                 % (name, sorted(targets)))
+            t = targets[name]
+            reports[name] = analysis.lint_symbol(
+                t["sym"], shapes=t["shapes"], dtypes=t["dtypes"],
+                trace=not args.no_trace, is_train=not args.eval,
+                dtype_policy=args.policy, model=name)
+
+    if args.json:
+        print(json.dumps({n: r.to_dict() for n, r in reports.items()},
+                         indent=1))
+    else:
+        for name in sorted(reports):
+            print(reports[name].summary(max_findings=args.max_findings))
+
+    if args.write_baseline:
+        path = analysis.write_baseline(reports)
+        print("graph-lint: baseline written -> %s" % path)
+        return 0
+    if args.check:
+        ok, msgs = analysis.check_baseline(reports)
+        for m in msgs:
+            print("graph-lint: %s" % m)
+        print("graph-lint: baseline gate %s" % ("OK" if ok else "FAILED"))
+        return 0 if ok else 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ROOT)
+    sys.exit(main())
