@@ -129,9 +129,18 @@ stage "graph lint gate (trace-time, no device execution)"
 # prints the finding summary — docs/how_to/graph_lint.md
 python tools/graph_lint.py --check
 
+stage "fault-injection suite (sentinel / crash-resume / io recovery)"
+# every recovery path driven on demand via MXTPU_FAULTS — step sentinel
+# skip/abort, SIGKILL-faithful torn-checkpoint resume (subprocess),
+# iterator retry, prefetcher error propagation; CPU-fast, runs in the
+# FAST tier by design (docs/how_to/resilience.md)
+python -m pytest tests/test_resilience.py -q
+
 stage "unit tests (virtual 8-device CPU mesh)"
-# test_dist.py re-runs the launcher/consistency scripts below
+# test_dist.py re-runs the launcher/consistency scripts below;
+# test_resilience.py already ran as its own stage above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
+    --ignore=tests/test_resilience.py \
     ${PYTEST_MARK[@]+"${PYTEST_MARK[@]}"}
 
 stage "distributed (2-worker local launcher)"

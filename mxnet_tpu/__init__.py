@@ -117,6 +117,10 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import parallel
 from . import analysis
+from . import faults
+from . import resilience
+from .resilience import CheckpointManager
+from . import health
 
 # Custom op front-ends (reference mx.nd.Custom / mx.sym.Custom)
 ndarray.Custom = operator._custom_entry("nd")

@@ -156,6 +156,8 @@ def _trace_into(report, sym, ann, is_train, platform, dtype_policy,
 
 # ----------------------------------------------------------------------
 _STEP_ARG_LABELS = ("params", "aux", "opt_state", "batch", "lr", "t", "key")
+_STEP_ARG_LABELS_SENTINEL = ("params", "aux", "opt_state", "sentinel",
+                             "batch", "lr", "t", "key")
 
 
 def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
@@ -178,15 +180,20 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
                          "(call bind() + init_params() first)")
     input_dtypes = input_dtypes or {}
     sds = lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)  # noqa: E731
+    sent = getattr(trainer, "_sent", None)
     args = (
         {n: sds(v) for n, v in trainer.params.items()},
         {n: sds(v) for n, v in trainer.aux.items()},
         jax.tree_util.tree_map(sds, trainer.opt_state),
+    ) + ((jax.tree_util.tree_map(sds, sent),) if sent is not None
+         else ()) + (
         {n: jax.ShapeDtypeStruct(tuple(s),
                                  np.dtype(input_dtypes.get(n, np.float32)))
          for n, s in trainer._input_shapes.items()},
         jnp.float32(0.01), jnp.int32(1), jax.random.key(0),
     )
+    arg_labels = _STEP_ARG_LABELS if sent is None \
+        else _STEP_ARG_LABELS_SENTINEL
     report = LintReport(model="trainer-step")
     try:
         # same x64 trace as _trace_into: an f64 cast must APPEAR in the
@@ -205,8 +212,8 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
         jaxpr = eqns[0].params["jaxpr"]
         donated = eqns[0].params.get("donated_invars")
         leaves = jax.tree_util.tree_flatten_with_path(args)[0]
-        labels = ["%s%s" % (_STEP_ARG_LABELS[p[0].idx]
-                            if p and p[0].idx < len(_STEP_ARG_LABELS)
+        labels = ["%s%s" % (arg_labels[p[0].idx]
+                            if p and p[0].idx < len(arg_labels)
                             else "arg%d" % (p[0].idx if p else 0),
                             jax.tree_util.keystr(p[1:]))
                   for p, _ in leaves]

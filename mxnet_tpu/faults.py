@@ -1,0 +1,229 @@
+"""Deterministic fault injection for the training resilience layer.
+
+The reference's failure paths (ps-lite node death, engine op errors)
+were exercised by chaos in production; here every recovery path is
+drivable on demand from a small declarative spec, so the tier-1 tests
+can assert "the step skipped the NaN batch" or "the resume scan
+ignored the torn checkpoint" in milliseconds instead of trusting the
+code on faith.
+
+Spec grammar (``MXTPU_FAULTS`` or :func:`configure`)::
+
+    spec      := directive (";" directive)*
+    directive := kind "@" item (":" item)*
+    item      := key "=" int | bare-word
+
+* ``kind`` names the fault (``nan_grad``, ``io_error``, ``crash``).
+* A ``key=int`` item is a threshold on a counter the injection site
+  reports (``step=3`` arms once the site's ``step`` reaches 3).
+* A bare word must equal the site's ``site=`` context value
+  (``crash@ckpt_write`` fires at the checkpoint-write site).
+* ``count=N`` fires the directive on its first N armed hits
+  (default 1) — e.g. ``io_error@batch=5:count=2`` fails the batch-5
+  fetch twice, so a 3-attempt retry loop recovers and a 2-attempt one
+  does not.
+
+Injection sites (each passes its own counters; all are no-ops when no
+spec is installed):
+
+* ``nan_grad`` — :meth:`Trainer.step <mxnet_tpu.parallel.trainer.
+  Trainer.step>` poisons the staged batch with NaN (``step=`` is the
+  1-based update counter), exercising the step sentinel.
+* ``io_error`` — ``DataIter.__next__`` (``site=iter_next``,
+  ``batch=`` batches fetched so far) and ``Heartbeat._beat``
+  (``site=hb_stamp``, ``beat=``) raise ``OSError``.
+* ``crash`` — ``model._atomic_save`` (``site=ckpt_write``, ``save=``)
+  calls ``os._exit(137)`` AFTER the tmp write and BEFORE the rename:
+  a SIGKILL-faithful torn checkpoint, no atexit hooks, no flushes.
+
+Example::
+
+    MXTPU_FAULTS="nan_grad@step=3;io_error@batch=5:count=2;crash@ckpt_write"
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+
+__all__ = ["configure", "clear", "active", "hit", "maybe_crash",
+           "fired", "injected", "InjectedCrash"]
+
+_ENV = "MXTPU_FAULTS"
+
+
+class InjectedCrash(BaseException):
+    """Raised instead of ``os._exit`` when a crash directive carries the
+    ``soft`` flag — lets a single-process test observe the torn state
+    without dying.  Derives from BaseException so ordinary ``except
+    Exception`` recovery code cannot accidentally swallow the "kill"."""
+
+
+class _Directive:
+    __slots__ = ("kind", "conds", "sites", "count", "soft", "fired")
+
+    def __init__(self, kind: str, conds: Dict[str, int], sites: List[str],
+                 count: int, soft: bool):
+        self.kind = kind
+        self.conds = conds
+        self.sites = sites
+        self.count = count
+        self.soft = soft
+        self.fired = 0
+
+    def matches(self, ctx: Dict) -> bool:
+        if self.fired >= self.count:
+            return False
+        for site in self.sites:
+            if ctx.get("site") != site:
+                return False
+        for key, threshold in self.conds.items():
+            val = ctx.get(key)
+            if val is None or int(val) < threshold:
+                return False
+        return True
+
+
+def _parse(spec: str) -> List[_Directive]:
+    out = []
+    for raw in spec.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        kind, sep, rest = raw.partition("@")
+        kind = kind.strip()
+        if not sep or not kind or not rest.strip():
+            raise MXNetError(
+                "bad fault directive %r (want kind@cond[:cond...], e.g. "
+                "nan_grad@step=3)" % raw)
+        conds, sites, count, soft = {}, [], 1, False
+        for item in rest.split(":"):
+            item = item.strip()
+            if not item:
+                continue
+            key, eq, val = item.partition("=")
+            if eq:
+                try:
+                    ival = int(val)
+                except ValueError:
+                    raise MXNetError(
+                        "bad fault condition %r in %r (values are "
+                        "integers)" % (item, raw)) from None
+                if key == "count":
+                    count = ival
+                else:
+                    conds[key] = ival
+            elif item == "soft":
+                soft = True
+            else:
+                sites.append(item)
+        out.append(_Directive(kind, conds, sites, count, soft))
+    return out
+
+
+_lock = threading.Lock()
+_directives: List[_Directive] = []
+_configured = False        # explicit configure() beats the env
+_ACTIVE = False            # lock-free fast-path flag for hot sites
+
+
+def configure(spec: Optional[str] = None) -> None:
+    """Install a fault spec (``None`` re-reads ``MXTPU_FAULTS``)."""
+    global _directives, _configured, _ACTIVE
+    if spec is None:
+        spec = os.environ.get(_ENV, "")
+    with _lock:
+        _directives = _parse(spec)
+        _configured = True
+        _ACTIVE = bool(_directives)
+
+
+def clear() -> None:
+    """Remove every directive (and forget the env spec)."""
+    global _directives, _configured, _ACTIVE
+    with _lock:
+        _directives = []
+        _configured = True
+        _ACTIVE = False
+
+
+def _ensure_loaded() -> None:
+    global _ACTIVE
+    if not _configured:
+        configure(None)
+
+
+def active(kind: Optional[str] = None) -> bool:
+    """Whether any (or any ``kind``) directive is installed and unspent."""
+    _ensure_loaded()
+    with _lock:
+        return any((kind is None or d.kind == kind) and d.fired < d.count
+                   for d in _directives)
+
+
+def hit(kind: str, **ctx) -> bool:
+    """Report reaching an injection site.  Returns True exactly when a
+    matching directive fires (and consumes one of its ``count``)."""
+    if not _ACTIVE and _configured:
+        return False
+    _ensure_loaded()
+    with _lock:
+        for d in _directives:
+            if d.kind == kind and d.matches(ctx):
+                d.fired += 1
+                return True
+    return False
+
+
+def fired(kind: str) -> int:
+    """Total fires of ``kind`` so far (test observability)."""
+    _ensure_loaded()
+    with _lock:
+        return sum(d.fired for d in _directives if d.kind == kind)
+
+
+def maybe_crash(site: str, **ctx) -> None:
+    """Crash-injection helper for write sites: on a matching ``crash``
+    directive, die like SIGKILL (``os._exit(137)`` — no atexit, no
+    buffered-IO flush) or raise :class:`InjectedCrash` for ``soft``
+    directives."""
+    if not _ACTIVE and _configured:
+        return
+    _ensure_loaded()
+    with _lock:
+        firing = None
+        for d in _directives:
+            if d.kind == "crash" and d.matches(dict(ctx, site=site)):
+                d.fired += 1
+                firing = d
+                break
+    if firing is None:
+        return
+    if firing.soft:
+        raise InjectedCrash("injected crash at %s" % site)
+    os._exit(137)
+
+
+class injected:
+    """``with faults.injected("nan_grad@step=3"): ...`` — scoped spec
+    for tests; restores the previous directives on exit."""
+
+    def __init__(self, spec: str):
+        self.spec = spec
+        self._saved = None
+
+    def __enter__(self):
+        global _directives, _configured, _ACTIVE
+        _ensure_loaded()
+        with _lock:
+            self._saved = (_directives, _configured, _ACTIVE)
+        configure(self.spec)
+        return self
+
+    def __exit__(self, *exc):
+        global _directives, _configured, _ACTIVE
+        with _lock:
+            _directives, _configured, _ACTIVE = self._saved
+        return False

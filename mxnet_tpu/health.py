@@ -23,15 +23,37 @@ is fresh, so mixed configurations never produce false positives.
 """
 from __future__ import annotations
 
+import atexit
 import os
 import threading
 import time
+import weakref
 from typing import List, Optional
+
+from . import faults as _faults
 
 __all__ = ["Heartbeat", "dead_nodes", "heartbeat_dir"]
 
 _DEFAULT_INTERVAL = 1.0
 _KV_PREFIX = "mxtpu/hb/"
+
+# every live Heartbeat, stopped at interpreter exit: the beat thread is
+# daemonic (it can never keep a wedged trainer alive), but an explicit
+# atexit stop also keeps a heartbeat from stamping "alive" while the
+# process is mid-shutdown — the window where a restart orchestrator
+# would otherwise wait a full timeout for the stamp to go stale
+_live_beats = weakref.WeakSet()
+
+
+def _stop_all_at_exit():
+    for hb in list(_live_beats):
+        try:
+            hb.stop()
+        except Exception:      # noqa: BLE001 — never block interpreter exit
+            pass
+
+
+atexit.register(_stop_all_at_exit)
 
 
 def heartbeat_dir() -> Optional[str]:
@@ -63,18 +85,30 @@ class Heartbeat:
         self.interval = interval
         self._stop = threading.Event()
         self._thread = None
+        self._beats = 0
         if self.directory:
             os.makedirs(self.directory, exist_ok=True)
         if self.directory or self._kv is not None:
-            self._beat()
+            try:
+                # a transiently failing first stamp (full disk, flaky
+                # NFS) must not kill construction: the beat thread keeps
+                # retrying every interval
+                self._beat()
+            except Exception:              # noqa: BLE001
+                pass
             self._thread = threading.Thread(target=self._run, daemon=True)
             self._thread.start()
+            _live_beats.add(self)
 
     @property
     def active(self) -> bool:
         return self._thread is not None
 
     def _beat(self):
+        self._beats += 1
+        if _faults.hit("io_error", site="hb_stamp", beat=self._beats):
+            raise OSError("injected io_error at heartbeat stamp %d"
+                          % self._beats)
         stamp = "%f" % time.time()
         if self.directory:
             with open(_stamp_path(self.directory, self.rank), "w") as f:
@@ -98,12 +132,28 @@ class Heartbeat:
 
 
 def _file_stamps(directory: str, num_workers: int) -> dict:
+    """Freshest evidence per rank from the stamp files.  A stamp caught
+    mid-write (empty, truncated float, interleaved garbage) or one that
+    cannot be opened still counts through its mtime — a rank must never
+    be declared dead because the SCANNER hit a torn read; only a stamp
+    with no readable evidence at all is skipped."""
     out = {}
     for rank in range(num_workers):
+        path = _stamp_path(directory, rank)
+        mtime = None
         try:
-            out[rank] = os.path.getmtime(_stamp_path(directory, rank))
+            mtime = os.path.getmtime(path)
         except OSError:
             pass
+        written = None
+        try:
+            with open(path) as f:
+                written = float(f.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            pass               # unreadable or partially written
+        candidates = [t for t in (mtime, written) if t is not None]
+        if candidates:
+            out[rank] = max(candidates)
     return out
 
 

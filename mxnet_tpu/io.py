@@ -30,6 +30,7 @@ import numpy as np
 
 from .base import MXNetError, mx_real_t, _dtype
 from .ndarray import NDArray, array
+from . import faults as _faults
 from . import ndarray as nd
 from . import recordio as _recordio
 from . import random as _random
@@ -94,7 +95,18 @@ class DataIter(object):
         return self
 
     def __next__(self):
-        return self.next()
+        # fault-injection point (docs/how_to/resilience.md): ``batch``
+        # counts batches this iterator DELIVERED over its lifetime, so a
+        # failed fetch keeps the same index and a bounded retry loop
+        # (resilience.retry_io around the fit inner loop) re-asks for
+        # the batch the consumer never got
+        fetched = getattr(self, "_faults_delivered", 0)
+        if _faults.hit("io_error", site="iter_next", batch=fetched):
+            raise OSError("injected io_error at %s batch %d"
+                          % (type(self).__name__, fetched))
+        batch = self.next()
+        self._faults_delivered = fetched + 1
+        return batch
 
     def reset(self):
         pass
@@ -222,6 +234,7 @@ class PrefetchingIter(_CurrentBatchAccessors, DataIter):
         self.current_batch = [None] * self.n_iter
         self.next_batch = [None] * self.n_iter
         self._scheduled = [False] * self.n_iter
+        self._errors = [None] * self.n_iter
         for i in range(self.n_iter):
             self._schedule(i)
 
@@ -230,9 +243,17 @@ class PrefetchingIter(_CurrentBatchAccessors, DataIter):
         writing var ``i``."""
 
         def produce():
+            # a producer failure is captured HERE (with its traceback
+            # still attached to the exception object) and re-raised by
+            # the consumer's next ``next()`` — NOT left to poison the
+            # engine-global error slot, where it would surface at some
+            # unrelated wait_all (an async checkpoint flush, GC)
             try:
                 self.next_batch[i] = self.iters[i].next()
             except StopIteration:
+                self.next_batch[i] = None
+            except BaseException as e:              # noqa: BLE001
+                self._errors[i] = e
                 self.next_batch[i] = None
 
         if self._engine is None:
@@ -250,8 +271,14 @@ class PrefetchingIter(_CurrentBatchAccessors, DataIter):
 
     def __del__(self):
         # bounded: a stuck producer (blocking source) must not hang GC —
-        # drain on a daemon thread with the old 1s-join patience
+        # drain on a daemon thread with the old 1s-join patience.  With
+        # nothing in flight (sync/NaiveEngine production, or already
+        # drained) skip the thread entirely: Thread.start() during
+        # interpreter finalization deadlocks CPython 3.10, turning a
+        # clean exit into a hang
         try:
+            if self._engine is None or not any(self._scheduled):
+                return
             t = threading.Thread(target=lambda: self._drain(reraise=False),
                                  daemon=True)
             t.start()
@@ -288,6 +315,7 @@ class PrefetchingIter(_CurrentBatchAccessors, DataIter):
         self._drain()
         for it in self.iters:
             it.reset()
+        self._errors = [None] * self.n_iter
         for i in range(self.n_iter):
             self._schedule(i)
 
@@ -296,6 +324,17 @@ class PrefetchingIter(_CurrentBatchAccessors, DataIter):
             if self._scheduled[i]:
                 self._engine.wait_for_var(self._vars[i])
                 self._scheduled[i] = False
+        for i in range(self.n_iter):
+            if self._errors[i] is not None:
+                err, self._errors[i] = self._errors[i], None
+                # REARM the slot before raising: a consumer that treats
+                # the error as transient (fit's retry_io loop) continues
+                # the stream on its next next(); without this the
+                # errored slot would read as a silent end-of-epoch
+                self._schedule(i)
+                # re-raising the captured instance keeps the producer
+                # thread's original traceback on the chain
+                raise err
         if self.next_batch[0] is None:
             for b in self.next_batch:
                 assert b is None, "Number of entry mismatches between iterators"

@@ -98,13 +98,68 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             updater(idx * num_device + dev, g, w)
 
 
-def _atomic_save(path, save_dict):
-    """Write-then-rename so a crash mid-write never leaves a truncated
-    checkpoint where auto-resume would pick it up."""
+_atomic_saves = 0
+
+
+def _commit_file(path, write_fn, crash_site=None, **crash_ctx):
+    """Shared atomic-commit recipe: ``write_fn(tmp_path)``, fsync the
+    tmp file, rename into place, best-effort fsync the parent directory.
+
+    The fsync matters on the crash side of the contract: ``os.replace``
+    is atomic against a process crash, but without flushing the tmp
+    file's data first a KERNEL crash can rename a file whose bytes never
+    hit the platter — a complete-looking, corrupt file.  The directory
+    fsync (best-effort: not every filesystem allows it) persists the
+    rename itself.  ``crash_site`` arms the fault-injection window
+    between the data flush and the rename — the window that leaks
+    ``*.tmp`` and leaves the PREVIOUS version as the visible one."""
     import os
+    from . import faults as _faults
     tmp = path + ".tmp"
-    nd.save(tmp, save_dict)
+    write_fn(tmp)
+    with open(tmp, "rb") as f:
+        os.fsync(f.fileno())
+    if crash_site is not None:
+        _faults.maybe_crash(crash_site, **crash_ctx)
     os.replace(tmp, path)
+    try:
+        dfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+def _atomic_save(path, save_dict):
+    """Atomically commit an NDArray dict so a crash mid-write never
+    leaves a truncated checkpoint where auto-resume would pick it up
+    (``crash@ckpt_write`` fires between write and rename; ``save=`` is
+    the per-process save counter)."""
+    global _atomic_saves
+    _atomic_saves += 1
+    _commit_file(path, lambda tmp: nd.save(tmp, save_dict),
+                 crash_site="ckpt_write", save=_atomic_saves)
+
+
+def _sweep_stale_tmp(prefix):
+    """Delete ``*.tmp`` leftovers from saves that crashed between write
+    and rename (the resume scan calls this: a leaked tmp is dead weight
+    forever otherwise — nothing else ever looks at it)."""
+    import glob
+    import os
+    removed = []
+    for path in glob.glob(glob.escape(prefix) + "*.tmp"):
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    if removed:
+        logging.info("removed %d stale checkpoint tmp file(s): %s",
+                     len(removed), ", ".join(removed))
+    return removed
 
 
 _ckpt_vars = {}
@@ -130,7 +185,10 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
     ``engine.get().wait_all()`` to be sure it landed (process exit
     flushes pending writes with a bounded ~10s grace)."""
     if symbol is not None:
-        symbol.save("%s-symbol.json" % prefix)
+        # atomic like the params file: prefix-symbol.json is SHARED by
+        # every epoch under the prefix, so a torn rewrite during a later
+        # save would break ALL previously-good checkpoints' load path
+        _commit_file("%s-symbol.json" % prefix, symbol.save)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
     save_dict.update({("aux:%s" % k): v for k, v in aux_params.items()})
     param_name = "%s-%04d.params" % (prefix, epoch)
@@ -166,9 +224,23 @@ def latest_checkpoint(prefix):
 
 
 def load_checkpoint(prefix, epoch):
-    """Load a checkpoint (reference ``model.py:342-375``)."""
+    """Load a checkpoint (reference ``model.py:342-375``).
+
+    A truncated or corrupt params file raises :class:`MXNetError` naming
+    the offending file — never a raw ``struct.error``/``ValueError``
+    from deep inside deserialization, which tells the caller nothing
+    about WHICH file to delete or re-fetch."""
     symbol = sym.load("%s-symbol.json" % prefix)
-    save_dict = nd.load("%s-%04d.params" % (prefix, epoch))
+    param_file = "%s-%04d.params" % (prefix, epoch)
+    try:
+        save_dict = nd.load(param_file)
+    except MXNetError as e:
+        raise MXNetError("checkpoint params file %r is truncated or "
+                         "corrupt: %s" % (param_file, e)) from e
+    except Exception as e:                          # noqa: BLE001
+        raise MXNetError("checkpoint params file %r is truncated or "
+                         "corrupt: %s: %s"
+                         % (param_file, type(e).__name__, e)) from e
     arg_params = {}
     aux_params = {}
     for k, v in save_dict.items():

@@ -145,13 +145,41 @@ class BaseModule(object):
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None):
+            monitor=None, checkpoint=None, checkpoint_period=1,
+            resume=False):
         """The training driver: bind, init, then epochs of
-        forward_backward/update/update_metric with callbacks."""
+        forward_backward/update/update_metric with callbacks.
+
+        ``checkpoint`` (a prefix string or a
+        :class:`~mxnet_tpu.resilience.CheckpointManager`) saves a
+        CRC-manifested checkpoint — params + optimizer states + cursor —
+        every ``checkpoint_period`` epochs; with ``resume=True`` a
+        killed run re-launched with the same arguments continues from
+        the newest INTACT checkpoint (torn or corrupt saves are skipped
+        by the scan) and, given a deterministic iterator, reproduces the
+        uninterrupted run bit-for-bit (docs/how_to/resilience.md)."""
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             from ..initializer import Uniform
             initializer = Uniform(0.01)
+
+        ckpt_mgr = None
+        if checkpoint is not None:
+            from .. import resilience
+            ckpt_mgr = checkpoint \
+                if isinstance(checkpoint, resilience.CheckpointManager) \
+                else resilience.CheckpointManager(checkpoint)
+        resumed = None
+        if resume:
+            assert ckpt_mgr is not None, \
+                "fit(resume=True) needs checkpoint=<prefix or manager>"
+            resumed = ckpt_mgr.latest()
+            if resumed is not None:
+                _, arg_params, aux_params = resumed.load_params()
+                begin_epoch = resumed.epoch
+                self.logger.info(
+                    "auto-resume: continuing from checkpoint epoch %d "
+                    "(step %s)", resumed.epoch, resumed.step)
 
         self.bind(train_data.provide_data, train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -162,6 +190,11 @@ class BaseModule(object):
                          arg_params=arg_params, aux_params=aux_params)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resumed is not None and resumed.states_path:
+            # optimizer state (momentum, the fused trainer's update
+            # cursor + sentinel counters) must land AFTER init_optimizer
+            # built the structures it restores into
+            self.load_optimizer_states(resumed.states_path)
 
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
@@ -185,6 +218,17 @@ class BaseModule(object):
                 # pull trained values off the devices and refresh mirrors
                 arg_snap, aux_snap = self.get_params()
                 self.set_params(arg_snap, aux_snap)
+                trainer = getattr(self, "_trainer", None)
+                if trainer is not None and trainer.sentinel != "off":
+                    skips = trainer.sentinel_skips
+                    if skips:
+                        self.logger.warning(
+                            "Epoch[%d] sentinel skipped %d non-finite "
+                            "step(s) so far", epoch, skips)
+                if ckpt_mgr is not None and \
+                        (epoch + 1) % checkpoint_period == 0:
+                    ckpt_mgr.save(self, epoch + 1, arg_params=arg_snap,
+                                  aux_params=aux_snap)
                 if epoch_end_callback is not None:
                     for cb in _as_list(epoch_end_callback):
                         cb(epoch, self.symbol, arg_snap, aux_snap)
@@ -243,10 +287,25 @@ class BaseModule(object):
 
     def _train_epoch(self, epoch, train_data, eval_metric,
                      batch_end_callback, monitor):
-        """One pass over ``train_data``; returns the wall time."""
+        """One pass over ``train_data``; returns the wall time.
+
+        Batch fetches ride :func:`~mxnet_tpu.resilience.retry_io`: a
+        transient ``OSError`` from the input pipeline (flaky NFS read,
+        preempted record fetch — or an injected ``io_error`` fault) is
+        retried with backoff instead of killing the epoch; a persistent
+        one still propagates after the attempts run out."""
+        from ..resilience import retry_io
         eval_metric.reset()
         tic = time.time()
-        for nbatch, data_batch in enumerate(train_data):
+        data_iter = iter(train_data)
+        nbatch = 0
+        while True:
+            try:
+                data_batch = retry_io(lambda: next(data_iter),
+                                      what="train batch fetch",
+                                      logger=self.logger)
+            except StopIteration:
+                break
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(data_batch)
@@ -259,6 +318,7 @@ class BaseModule(object):
                         BatchEndParam(epoch=epoch, nbatch=nbatch,
                                       eval_metric=eval_metric,
                                       locals=locals()))
+            nbatch += 1
         return time.time() - tic
 
     # ==================================================================

@@ -656,11 +656,24 @@ class Module(BaseModule):
             with open(fname, "wb") as fout:
                 fout.write(self._updater.get_states())
 
+    @property
+    def sentinel_skips(self):
+        """Fused-path step-sentinel skip count (0 on the classic path —
+        its per-op executors have no fused finiteness watch)."""
+        if self._trainer is not None:
+            return self._trainer.sentinel_skips
+        return 0
+
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._trainer is not None:
             with open(fname, "rb") as fin:
-                self._trainer.set_opt_states(fin.read())
+                blob = fin.read()
+            try:
+                self._trainer.set_opt_states(blob)
+            except MXNetError as e:
+                raise MXNetError("optimizer states file %r: %s"
+                                 % (fname, e)) from e
         elif self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:

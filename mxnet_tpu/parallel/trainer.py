@@ -30,10 +30,50 @@ from ..ndarray import NDArray
 from ..executor import _GraphProgram
 from ..initializer import InitDesc
 from .. import initializer as _init_mod
+from .. import faults as _faults
 from .mesh import batch_sharding, replicated
 from .optim import make_update_fn
 
 __all__ = ["Trainer", "remat_policy"]
+
+# dynamic loss-scale schedule (the standard GradScaler constants): halve
+# on a non-finite step, double after GROWTH_INTERVAL consecutive clean
+# steps, clamp to [1, 2**24]
+_LS_INIT = 2.0 ** 15
+_LS_MAX = 2.0 ** 24
+_LS_GROWTH_INTERVAL = 200
+
+# MXNet-style output ops whose custom vjp INJECTS the loss gradient and
+# (with out_grad left False) discards the upstream cotangent — seed-side
+# loss scaling cannot reach a backward that starts at one of these, so
+# the trainer refuses to silently mis-scale and runs with scaling inert
+_FIXED_LOSS_OPS = frozenset((
+    "SoftmaxOutput", "Softmax", "SVMOutput", "MakeLoss",
+    "LinearRegressionOutput", "LogisticRegressionOutput",
+    "MAERegressionOutput",
+))
+
+
+def _seeds_reach_grads(symbol) -> bool:
+    """True when every graph output propagates its cotangent seed (the
+    graph is linear in the seeds), i.e. no fixed-loss output op without
+    ``out_grad=True`` sits at a head."""
+    import json as _json
+    try:
+        graph = _json.loads(symbol.tojson())
+    except Exception:      # noqa: BLE001 — unparseable: assume linear
+        return True
+    nodes = graph.get("nodes", [])
+    for head in graph.get("heads", []):
+        node = nodes[head[0]] if head and head[0] < len(nodes) else None
+        if node is None:
+            continue
+        if node.get("op") in _FIXED_LOSS_OPS:
+            attrs = node.get("attrs") or node.get("param") or {}
+            if str(attrs.get("out_grad", "False")) not in ("True", "true",
+                                                           "1"):
+                return False
+    return True
 
 
 def remat_policy(name):
@@ -85,7 +125,11 @@ class Trainer:
                  mesh=None, compute_dtype=None,
                  param_specs: Optional[Dict[str, PartitionSpec]] = None,
                  remat: Optional[str] = None,
-                 dtype_policy: Optional[str] = None):
+                 dtype_policy: Optional[str] = None,
+                 sentinel: Optional[str] = None,
+                 loss_scale=None,
+                 sentinel_max_skips: Optional[int] = None,
+                 ls_growth_interval: Optional[int] = None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -121,6 +165,46 @@ class Trainer:
         self.dtype_policy = dtype_policy if dtype_policy is not None \
             else _os.environ.get("MXTPU_DTYPE_POLICY", None)
         self.prog.dtype_policy = self.dtype_policy
+        # --- step sentinel (docs/how_to/resilience.md): watch the f32
+        # grads' global finiteness INSIDE the jitted step and lax-select
+        # the old (params, aux, opt_state) on a non-finite batch — skip
+        # semantics with no host round-trip.  "off" keeps the step
+        # program byte-identical to the pre-sentinel build.
+        self.sentinel = sentinel if sentinel is not None \
+            else _os.environ.get("MXTPU_SENTINEL", "off")
+        if self.sentinel not in ("off", "skip", "abort"):
+            raise MXNetError("unknown sentinel mode %r (off|skip|abort)"
+                             % (self.sentinel,))
+        self.sentinel_max_skips = int(
+            sentinel_max_skips if sentinel_max_skips is not None
+            else _os.environ.get("MXTPU_SENTINEL_MAX_SKIPS", "3"))
+        # loss scale: None/off, "dynamic", or a fixed float.  Scales the
+        # cotangent seeds so a bf16 backward keeps small grads out of
+        # the flush-to-zero range; grads are unscaled in f32 before the
+        # finiteness check and the update, so the optimizer math never
+        # sees the scale.
+        if loss_scale is None:
+            loss_scale = _os.environ.get("MXTPU_LOSS_SCALE", "") or None
+        if loss_scale in ("off", "none", "0"):
+            loss_scale = None
+        if loss_scale is not None and loss_scale != "dynamic":
+            loss_scale = float(loss_scale)
+        self.loss_scale = loss_scale
+        self._ls_applies = True
+        if loss_scale is not None and not _seeds_reach_grads(symbol):
+            import logging as _logging
+            _logging.getLogger("mxtpu.trainer").warning(
+                "loss scale requested, but an output op of this graph "
+                "injects its loss gradient and discards upstream "
+                "cotangents (SoftmaxOutput-style, out_grad=False): the "
+                "seed-side scale cannot reach the backward; running "
+                "with scaling INERT (skip/abort sentinel unaffected)")
+            self._ls_applies = False
+        self.ls_growth_interval = int(
+            ls_growth_interval if ls_growth_interval is not None
+            else _os.environ.get("MXTPU_LS_GROWTH_INTERVAL",
+                                 str(_LS_GROWTH_INTERVAL)))
+        self._sent = None          # device sentinel state, see _init_sentinel
         self.param_specs = param_specs or {}
         input_set = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in self.prog.arg_names
@@ -199,7 +283,28 @@ class Trainer:
         init_fn, self._update_fn = make_update_fn(
             self.optimizer, self.param_names)
         self.opt_state = jax.jit(init_fn)(params)
+        if self.sentinel != "off" and self._sent is None:
+            # created once per trainer, NOT per (re-)init: init_params
+            # doesn't reset num_update, and Module.fit's epoch-end
+            # set_params refresh routes through here with force_init —
+            # recreating the state would silently zero the skip counters
+            # and desync the effective update cursor every epoch
+            self._sent = self._init_sentinel(self.num_update)
         return self
+
+    def _init_sentinel(self, t, skips=0, scale=None):
+        """Fresh device-side sentinel state.  ``t`` is the effective
+        update counter (advanced only on CLEAN steps, so a skipped batch
+        leaves the optimizer's time axis exactly where a dropped batch
+        would); ``skips``/``consec``/``good`` are the total-skip,
+        consecutive-skip, and clean-streak counters; ``scale`` the
+        current loss scale."""
+        if scale is None:
+            scale = _LS_INIT if self.loss_scale == "dynamic" else \
+                float(self.loss_scale or 1.0)
+        return {"skips": jnp.int32(skips), "consec": jnp.int32(0),
+                "good": jnp.int32(0), "t": jnp.int32(t),
+                "scale": jnp.float32(scale)}
 
     def _place(self, value, sharding):
         if sharding is None:
@@ -271,8 +376,12 @@ class Trainer:
             return outs, new_aux
 
         policy = remat_policy(self.remat)
+        sentinel_on = self.sentinel != "off"
+        scaling = self.loss_scale is not None and self._ls_applies
+        dynamic_ls = self.loss_scale == "dynamic"
+        growth = self.ls_growth_interval
 
-        def step(params, aux, opt_state, batch, lr, t, key):
+        def _backward(params, aux, batch, key, scale):
             aux_vals = [aux[n] for n in aux_names]
 
             def fwd(p):
@@ -286,23 +395,94 @@ class Trainer:
             # low-precision elementwise — the byte-diet dtype policy's
             # cotangent half; its reduction half (f32 accumulation)
             # lives in the op backward formulations (op/bytediet.py) and
-            # in the f32 master-weight grad cast below
-            cot = (tuple(jnp.ones(o.shape, o.dtype) for o in outs),
+            # in the f32 master-weight grad cast below.  The loss scale
+            # rides the seeds (and is divided back out of the f32
+            # grads): small bf16 cotangents stay out of flush-to-zero.
+            if scale is None:
+                seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+            else:
+                seeds = tuple(jnp.full(o.shape, scale.astype(o.dtype),
+                                       o.dtype) for o in outs)
+            cot = (seeds,
                    tuple(jnp.zeros(a.shape, a.dtype) for a in new_aux))
             grads = vjp(cot)[0]
-            grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
-            # named scope: the breakdown tool attributes optimizer-state
-            # traffic to this label instead of "(unattributed)"
-            with jax.named_scope("optimizer_update"):
-                new_params, new_state = update_fn(params, grads, opt_state,
-                                                  lr, t)
+            if scale is None:
+                grads = {n: g.astype(jnp.float32)
+                         for n, g in grads.items()}
+            else:
+                inv = 1.0 / scale
+                grads = {n: g.astype(jnp.float32) * inv
+                         for n, g in grads.items()}
             # aux (BN moving stats) keep fp32 master copies like params do
             new_aux = tuple(
                 v.astype(jnp.float32)
                 if jnp.issubdtype(v.dtype, jnp.floating) else v
                 for v in new_aux)
+            return outs, new_aux, grads
+
+        def step(params, aux, opt_state, batch, lr, t, key):
+            outs, new_aux, grads = _backward(params, aux, batch, key, None)
+            # named scope: the breakdown tool attributes optimizer-state
+            # traffic to this label instead of "(unattributed)"
+            with jax.named_scope("optimizer_update"):
+                new_params, new_state = update_fn(params, grads, opt_state,
+                                                  lr, t)
             return (new_params, dict(zip(aux_names, new_aux)), new_state,
                     tuple(o.astype(jnp.float32) for o in outs))
+
+        param_names_sorted = list(self.param_names)
+
+        def step_sentinel(params, aux, opt_state, sent, batch, lr, t, key):
+            """The sentinel build: same math as ``step`` plus a global
+            grad-finiteness flag on the already-materialized f32 grads.
+            Non-finite ⇒ every state leaf lax-selects its OLD value (the
+            skip), the effective update counter ``sent["t"]`` holds, and
+            the skip counters advance — all on device, zero host
+            round-trips (the ``abort`` host check reads ``consec``
+            explicitly).  Skip-equals-drop is exact for the optimizer's
+            time axis; the HOST ``num_update`` (lr_scheduler ticks, the
+            step RNG key) still advances on a skip — GradScaler
+            semantics, see docs/how_to/resilience.md."""
+            scale = sent["scale"] if scaling else None
+            outs, new_aux, grads = _backward(params, aux, batch, key,
+                                             scale)
+            with jax.named_scope("sentinel_finite"):
+                finite = jnp.bool_(True)
+                for n in param_names_sorted:
+                    finite = jnp.logical_and(
+                        finite, jnp.all(jnp.isfinite(grads[n])))
+            t_eff = sent["t"] + 1
+            with jax.named_scope("optimizer_update"):
+                new_params, new_state = update_fn(params, grads, opt_state,
+                                                  lr, t_eff)
+            with jax.named_scope("sentinel_select"):
+                keep = lambda new, old: jnp.where(finite, new, old)  # noqa: E731
+                new_params = jax.tree.map(keep, new_params, params)
+                new_state = jax.tree.map(keep, new_state, opt_state)
+                new_aux = tuple(keep(v, aux[n])
+                                for n, v in zip(aux_names, new_aux))
+            good = jnp.where(finite, sent["good"] + 1, jnp.int32(0))
+            new_scale = sent["scale"]
+            if dynamic_ls:
+                grown = good >= growth
+                new_scale = jnp.where(
+                    finite,
+                    jnp.where(grown,
+                              jnp.minimum(new_scale * 2.0,
+                                          jnp.float32(_LS_MAX)),
+                              new_scale),
+                    jnp.maximum(new_scale * 0.5, jnp.float32(1.0)))
+                good = jnp.where(grown, jnp.int32(0), good)
+            new_sent = {
+                "skips": sent["skips"] + jnp.where(finite, 0, 1),
+                "consec": jnp.where(finite, jnp.int32(0),
+                                    sent["consec"] + 1),
+                "good": good,
+                "t": jnp.where(finite, t_eff, sent["t"]),
+                "scale": new_scale,
+            }
+            return (new_params, dict(zip(aux_names, new_aux)), new_state,
+                    new_sent, tuple(o.astype(jnp.float32) for o in outs))
 
         def evaluate(params, aux, batch, key):
             aux_vals = [aux[n] for n in aux_names]
@@ -327,12 +507,21 @@ class Trainer:
             rep = replicated(mesh)
             p_shard = {n: self._param_sharding(n) for n in self.param_names}
             a_shard = {n: self._param_sharding(n) for n in self.aux_names}
-            # opt state mirrors param sharding per leaf
-            self._step_fn = jax.jit(
-                step,
-                in_shardings=(p_shard, a_shard, None,
-                              self._batch_shardings, None, None, None),
-                donate_argnums=(0, 1, 2))
+            # opt state mirrors param sharding per leaf; the sentinel
+            # state is five replicated scalars (sharding left to the
+            # partitioner), donated with the rest of the carried state
+            if sentinel_on:
+                self._step_fn = jax.jit(
+                    step_sentinel,
+                    in_shardings=(p_shard, a_shard, None, None,
+                                  self._batch_shardings, None, None, None),
+                    donate_argnums=(0, 1, 2, 3))
+            else:
+                self._step_fn = jax.jit(
+                    step,
+                    in_shardings=(p_shard, a_shard, None,
+                                  self._batch_shardings, None, None, None),
+                    donate_argnums=(0, 1, 2))
             self._eval_fn = jax.jit(
                 evaluate,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
@@ -340,7 +529,11 @@ class Trainer:
                 evaluate_train,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
         else:
-            self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+            if sentinel_on:
+                self._step_fn = jax.jit(step_sentinel,
+                                        donate_argnums=(0, 1, 2, 3))
+            else:
+                self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
             self._eval_fn = jax.jit(evaluate)
             self._eval_train_fn = jax.jit(evaluate_train)
 
@@ -379,13 +572,64 @@ class Trainer:
         key = jax.random.fold_in(self._key, self.num_update) \
             if self.prog.has_rng else self._key
         dev_batch = self._device_batch(batch)
+        # fault injection (docs/how_to/resilience.md): poison the staged
+        # batch so the backward materializes non-finite grads and the
+        # sentinel's skip/abort path runs for real
+        if _faults.hit("nan_grad", step=self.num_update):
+            dev_batch = self._poison_batch(dev_batch)
         # cache the lr device scalar: one H2D per lr *change*, not per step
         if self._lr_cache is None or self._lr_cache[0] != lr:
             self._lr_cache = (lr, jnp.float32(lr))
-        self.params, self.aux, self.opt_state, outs = self._step_fn(
-            self.params, self.aux, self.opt_state, dev_batch,
-            self._lr_cache[1], jnp.int32(max(1, self.num_update)), key)
+        if self._sent is not None:
+            (self.params, self.aux, self.opt_state, self._sent,
+             outs) = self._step_fn(
+                self.params, self.aux, self.opt_state, self._sent,
+                dev_batch, self._lr_cache[1],
+                jnp.int32(max(1, self.num_update)), key)
+            if self.sentinel == "abort":
+                # abort mode accepts the per-step device->host sync: the
+                # point IS to stop the moment K batches in a row went bad
+                consec = int(np.asarray(
+                    self._host_value(self._sent["consec"])))
+                if consec >= self.sentinel_max_skips:
+                    raise MXNetError(
+                        "step sentinel: %d consecutive non-finite "
+                        "gradient steps (threshold %d) at update %d — "
+                        "aborting (MXTPU_SENTINEL=abort)"
+                        % (consec, self.sentinel_max_skips,
+                           self.num_update))
+        else:
+            self.params, self.aux, self.opt_state, outs = self._step_fn(
+                self.params, self.aux, self.opt_state, dev_batch,
+                self._lr_cache[1], jnp.int32(max(1, self.num_update)), key)
         return [NDArray(self._local_rows(o)) for o in outs]
+
+    def _poison_batch(self, dev_batch: Dict) -> Dict:
+        """Replace the first floating input with NaN (the ``nan_grad``
+        fault): elementwise multiply keeps shape, dtype, and sharding."""
+        out = dict(dev_batch)
+        for n in self.data_names + self.label_names:
+            v = out.get(n)
+            if v is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                out[n] = v * jnp.asarray(float("nan"), v.dtype)
+                return out
+        raise MXNetError("nan_grad fault: no floating input to poison "
+                         "among %s" % (list(dev_batch),))
+
+    @property
+    def sentinel_skips(self) -> int:
+        """Total sentinel-skipped steps (device counter; reading it
+        syncs, so poll it at epoch/bench granularity, not per step)."""
+        if self._sent is None:
+            return 0
+        return int(np.asarray(self._host_value(self._sent["skips"])))
+
+    @property
+    def loss_scale_value(self) -> float:
+        """Current loss scale (1.0 when scaling is off)."""
+        if self._sent is None:
+            return 1.0
+        return float(np.asarray(self._host_value(self._sent["scale"])))
 
     def forward(self, batch: Dict) -> List[NDArray]:
         """Inference forward (is_train=False) as one compiled program."""
@@ -418,21 +662,51 @@ class Trainer:
                                      input_dtypes=input_dtypes)
 
     def get_opt_states(self) -> bytes:
-        """Serialize (num_update, optimizer state pytree) — the fused
-        analog of ``Updater.get_states`` (reference ``optimizer.py``)."""
+        """Serialize (num_update, optimizer state pytree[, sentinel
+        state]) — the fused analog of ``Updater.get_states`` (reference
+        ``optimizer.py``).  The sentinel's effective update counter and
+        loss scale ride along so a resumed run continues the SAME time
+        axis a skip-free replay would."""
         import pickle
         state = jax.tree.map(self._host_value, self.opt_state)
-        return pickle.dumps((self.num_update, state))
+        if self._sent is None:
+            return pickle.dumps((self.num_update, state))
+        sent = {k: np.asarray(self._host_value(v))
+                for k, v in self._sent.items()}
+        return pickle.dumps((self.num_update, state, sent))
 
     def set_opt_states(self, blob: bytes) -> None:
         import pickle
-        num_update, state = pickle.loads(blob)
+        try:
+            loaded = pickle.loads(blob)
+        except Exception as e:                      # noqa: BLE001
+            raise MXNetError(
+                "optimizer state blob is truncated or corrupt: %s"
+                % (e,)) from e
+        sent_host = None
+        if len(loaded) == 3:
+            num_update, state, sent_host = loaded
+        else:                      # pre-sentinel blobs stay loadable
+            num_update, state = loaded
         self.num_update = num_update
         self.optimizer.num_update = num_update
+        if self.sentinel != "off":
+            if sent_host is not None:
+                self._sent = {k: (jnp.float32(v) if k == "scale"
+                                  else jnp.int32(v))
+                              for k, v in sent_host.items()}
+            else:
+                # blob predates the sentinel: seed the effective update
+                # counter from num_update (no skips recorded)
+                self._sent = self._init_sentinel(num_update)
         cur = self.opt_state
 
-        def _restore(c, n):
-            sharding = getattr(c, "sharding", None)
+        def _restore(sharding, c, n):
+            # restore onto the PARAM sharding (opt state mirrors it per
+            # leaf) — the current leaf's own sharding can be an
+            # uncommitted single-device placement from the jitted
+            # init_fn, and committing the restored copy there would trip
+            # the step's device-set consistency check on a mesh
             if sharding is None:
                 return jnp.asarray(n)
             if self.multihost:
@@ -443,7 +717,11 @@ class Trainer:
                     n.shape, sharding, lambda idx: n[idx])
             return jax.device_put(jnp.asarray(n), sharding)
 
-        self.opt_state = jax.tree.map(_restore, cur, state)
+        self.opt_state = {
+            name: jax.tree.map(
+                lambda c, n, _sh=self._param_sharding(name):
+                _restore(_sh, c, n), cur[name], state[name])
+            for name in cur}
 
     # ------------------------------------------------------------------
     def _host_value(self, v):

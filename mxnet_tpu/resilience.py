@@ -1,0 +1,298 @@
+"""Crash-consistent checkpointing and auto-resume for the training loop.
+
+The reference's fault story is detection (ps-lite heartbeats →
+``get_num_dead_node``) plus restart-aware barriers; what it never had is
+a checkpoint line a restarted job can TRUST.  ``model._atomic_save``
+already guarantees no torn params file survives a crash; this module
+adds the rest of the contract:
+
+* :class:`CheckpointManager` — every save is stamped with a JSON
+  manifest recording the CRC32 + size of each artifact (params,
+  optimizer states) plus the step/epoch cursor and RNG seed.  The
+  manifest is written LAST (atomically, fsync'd): its presence is the
+  commit record.  A crash at any earlier point leaves either a stale
+  ``*.tmp`` (swept by the resume scan) or a manifest-less params file
+  (ignored by the resume scan) — never a checkpoint that loads wrong.
+* :func:`CheckpointManager.latest` — scans manifests newest-first,
+  verifies every listed artifact against its recorded CRC/size, and
+  falls back past truncated/corrupt/incomplete candidates to the newest
+  checkpoint that checks out.
+* :func:`retry_io` — bounded retry-with-backoff for transient iterator
+  and checkpoint IO failures (the flaky-NFS / preempted-reader class),
+  used by ``BaseModule.fit``'s inner loop and by every manager write.
+
+``BaseModule.fit(..., checkpoint=prefix, resume=True)`` wires all of it
+into the training driver: a killed run re-launched with the same command
+line continues from the newest intact checkpoint and — with a
+deterministic iterator — reproduces the uninterrupted run's parameters
+bit-for-bit (tests/test_resilience.py asserts exactly that).
+"""
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import time
+import zlib
+from typing import Callable, Optional, Sequence, Tuple
+
+from .base import MXNetError
+
+__all__ = ["CheckpointManager", "Checkpoint", "retry_io"]
+
+_MANIFEST_VERSION = 1
+
+
+def retry_io(fn: Callable, attempts: int = 3, delay: float = 0.05,
+             backoff: float = 2.0,
+             exceptions: Tuple = (OSError,), what: str = "io",
+             logger=logging):
+    """Call ``fn()`` with up to ``attempts`` tries, sleeping
+    ``delay * backoff**k`` between consecutive failures of the
+    ``exceptions`` classes; the final failure re-raises.  StopIteration
+    and non-listed exceptions propagate immediately (an exhausted
+    iterator or a logic error is not a transient fault)."""
+    attempts = max(1, int(attempts))
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except exceptions as e:
+            if attempt + 1 >= attempts:
+                raise
+            wait = delay * (backoff ** attempt)
+            logger.warning("%s failed (attempt %d/%d): %s — retrying "
+                           "in %.2fs", what, attempt + 1, attempts, e,
+                           wait)
+            time.sleep(wait)
+
+
+def _crc32_file(path: str, chunk: int = 1 << 20) -> Tuple[int, int]:
+    crc, size = 0, 0
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk)
+            if not buf:
+                break
+            crc = zlib.crc32(buf, crc)
+            size += len(buf)
+    return crc & 0xFFFFFFFF, size
+
+
+class Checkpoint:
+    """One verified on-disk checkpoint (a manifest that checked out)."""
+
+    def __init__(self, prefix: str, epoch: int, manifest: dict):
+        self.prefix = prefix
+        self.epoch = epoch
+        self.manifest = manifest
+
+    @property
+    def step(self) -> Optional[int]:
+        return self.manifest.get("step")
+
+    @property
+    def params_path(self) -> str:
+        return "%s-%04d.params" % (self.prefix, self.epoch)
+
+    @property
+    def states_path(self) -> Optional[str]:
+        name = os.path.basename("%s-%04d.states" % (self.prefix,
+                                                    self.epoch))
+        if name in self.manifest.get("files", {}):
+            return "%s-%04d.states" % (self.prefix, self.epoch)
+        return None
+
+    def load_params(self):
+        """(symbol, arg_params, aux_params) — via
+        :func:`mxnet_tpu.model.load_checkpoint`."""
+        from . import model as _model
+        return _model.load_checkpoint(self.prefix, self.epoch)
+
+    def __repr__(self):
+        return "Checkpoint(prefix=%r, epoch=%d)" % (self.prefix,
+                                                    self.epoch)
+
+
+class CheckpointManager:
+    """CRC-manifested checkpoint line under one ``prefix``.
+
+    ``save`` writes ``prefix-symbol.json`` + ``prefix-NNNN.params``
+    (+ ``.states`` when the module has an initialized optimizer), then
+    commits them with ``prefix-NNNN.manifest.json`` and prunes saves
+    beyond the newest ``keep``.  ``latest`` returns the newest
+    checkpoint whose every artifact still matches its manifest.
+
+    All disk writes go through :func:`retry_io` (``attempts`` /
+    ``delay`` tune the backoff); verification failures are never
+    retried — a bad CRC is damage, not weather.
+    """
+
+    def __init__(self, prefix: str, keep: int = 3, attempts: int = 3,
+                 delay: float = 0.05, logger=None):
+        self.prefix = str(prefix)
+        self.keep = int(keep)
+        self.attempts = int(attempts)
+        self.delay = float(delay)
+        self.logger = logger or logging.getLogger("mxtpu.resilience")
+        parent = os.path.dirname(os.path.abspath(self.prefix))
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+
+    # ------------------------------------------------------------- save
+    def _manifest_path(self, epoch: int) -> str:
+        return "%s-%04d.manifest.json" % (self.prefix, epoch)
+
+    def _retry(self, fn, what):
+        return retry_io(fn, attempts=self.attempts, delay=self.delay,
+                        what=what, logger=self.logger)
+
+    def save(self, module, epoch: int, arg_params=None, aux_params=None):
+        """Checkpoint ``module`` as epoch ``epoch`` (1-based: the number
+        of COMPLETED epochs, matching ``callback.do_checkpoint``)."""
+        from .model import save_checkpoint
+        if arg_params is None or aux_params is None:
+            arg_params, aux_params = module.get_params()
+        self._retry(
+            lambda: save_checkpoint(self.prefix, epoch, module.symbol,
+                                    arg_params, aux_params),
+            "checkpoint params write")
+        files = {}
+        params_file = "%s-%04d.params" % (self.prefix, epoch)
+        states_file = "%s-%04d.states" % (self.prefix, epoch)
+        symbol_file = "%s-symbol.json" % self.prefix
+        if getattr(module, "optimizer_initialized", False):
+            self._retry(lambda: module.save_optimizer_states(states_file),
+                        "optimizer state write")
+            crc, size = _crc32_file(states_file)
+            files[os.path.basename(states_file)] = {"crc32": crc,
+                                                    "size": size}
+        crc, size = _crc32_file(params_file)
+        files[os.path.basename(params_file)] = {"crc32": crc,
+                                                "size": size}
+        if os.path.exists(symbol_file):
+            # the symbol json is shared by every epoch under the prefix
+            # but it IS part of what load_checkpoint reads — a torn or
+            # swapped-out symbol must fail verification, not load
+            crc, size = _crc32_file(symbol_file)
+            files[os.path.basename(symbol_file)] = {"crc32": crc,
+                                                    "size": size}
+        trainer = getattr(module, "_trainer", None)
+        manifest = {
+            "version": _MANIFEST_VERSION,
+            "epoch": int(epoch),
+            "step": int(trainer.num_update) if trainer is not None
+            else None,
+            "sentinel_skips": trainer.sentinel_skips
+            if trainer is not None else None,
+            "rng": {"impl": "fold_in(key(0), num_update)"},
+            "wallclock": time.time(),
+            "files": files,
+        }
+        self._retry(lambda: self._write_manifest(epoch, manifest),
+                    "manifest write")
+        self._prune()
+        return Checkpoint(self.prefix, epoch, manifest)
+
+    def _write_manifest(self, epoch: int, manifest: dict):
+        """Atomic JSON commit record via the same fsync'd tmp+rename
+        recipe as ``model._atomic_save`` (shared ``_commit_file``: the
+        commit record must be at least as durable as the artifacts it
+        commits, parent-dir fsync included)."""
+        from .model import _commit_file
+
+        def write(tmp):
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=1, sort_keys=True)
+
+        _commit_file(self._manifest_path(epoch), write,
+                     crash_site="manifest_write", epoch=epoch)
+
+    # ------------------------------------------------------------- scan
+    def _epochs_on_disk(self) -> Sequence[int]:
+        out = []
+        for path in glob.glob(glob.escape(self.prefix)
+                              + "-[0-9][0-9][0-9][0-9].manifest.json"):
+            try:
+                out.append(int(path[-len("0000.manifest.json"):
+                                    -len(".manifest.json")]))
+            except ValueError:
+                pass
+        return sorted(out)
+
+    def verify(self, epoch: int) -> Optional[Checkpoint]:
+        """The checkpoint for ``epoch`` if every artifact matches its
+        manifest, else None (with the reason logged)."""
+        path = self._manifest_path(epoch)
+        try:
+            with open(path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            self.logger.warning("skipping checkpoint %04d: manifest "
+                                "unreadable (%s)", epoch, e)
+            return None
+        for name, meta in manifest.get("files", {}).items():
+            full = os.path.join(os.path.dirname(os.path.abspath(path)),
+                                name)
+            try:
+                crc, size = _crc32_file(full)
+            except OSError as e:
+                self.logger.warning("skipping checkpoint %04d: %s "
+                                    "unreadable (%s)", epoch, name, e)
+                return None
+            if size != meta.get("size") or crc != meta.get("crc32"):
+                self.logger.warning(
+                    "skipping checkpoint %04d: %s fails verification "
+                    "(size %d vs %s, crc %08x vs %s)", epoch, name,
+                    size, meta.get("size"), crc,
+                    ("%08x" % meta["crc32"]) if "crc32" in meta else "?")
+                return None
+        return Checkpoint(self.prefix, epoch, manifest)
+
+    def latest(self) -> Optional[Checkpoint]:
+        """Newest checkpoint that verifies, sweeping crash leftovers.
+
+        Scans manifests newest-first: a save killed mid-write left
+        either no manifest (ignored), a ``*.tmp`` (swept here), or
+        artifacts that fail their CRC (skipped with a warning) — the
+        scan keeps walking back until something checks out."""
+        from .model import _sweep_stale_tmp
+        _sweep_stale_tmp(self.prefix)
+        for epoch in reversed(self._epochs_on_disk()):
+            ck = self.verify(epoch)
+            if ck is not None:
+                return ck
+        return None
+
+    # ---------------------------------------------------------- prune
+    def _prune(self):
+        """Retention: drop everything older than the newest ``keep``
+        manifests (params + states + manifest per dropped epoch)."""
+        if self.keep <= 0:
+            return
+        epochs = self._epochs_on_disk()
+        for epoch in epochs[:-self.keep]:
+            for suffix in (".params", ".states", ".manifest.json"):
+                path = "%s-%04d%s" % (self.prefix, epoch, suffix)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    # --------------------------------------------------------- restore
+    def restore(self, module, ck: Optional[Checkpoint] = None
+                ) -> Optional[Checkpoint]:
+        """Load ``ck`` (default: :meth:`latest`) into a bound module —
+        params via ``set_params``, optimizer states when both sides have
+        them.  Returns the checkpoint used, or None."""
+        ck = ck or self.latest()
+        if ck is None:
+            return None
+        _, arg_params, aux_params = self._retry(ck.load_params,
+                                                "checkpoint read")
+        module.set_params(arg_params, aux_params)
+        if ck.states_path and getattr(module, "optimizer_initialized",
+                                      False):
+            self._retry(lambda: module.load_optimizer_states(
+                ck.states_path), "optimizer state read")
+        return ck

@@ -32,3 +32,54 @@ def test_heartbeat_noop_without_dir(monkeypatch):
 def test_kvstore_num_dead_node_local():
     kv = mx.kv.create("local")
     assert kv.num_dead_node() == 0
+
+
+def test_dead_nodes_tolerates_torn_and_unreadable_stamps(tmp_path,
+                                                         monkeypatch):
+    """A stamp caught mid-write (garbage/empty content) or unreadable as
+    a file still proves liveness through its mtime — the scanner must
+    never declare a rank dead because IT hit a torn read."""
+    import os
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    # rank 0: partially-written garbage, fresh mtime
+    (tmp_path / "hb-0").write_text("1723")  # truncated float is fine too
+    (tmp_path / "hb-0").write_text("garbage\x00")
+    # rank 1: empty file (open succeeds, parse fails)
+    (tmp_path / "hb-1").write_text("")
+    # rank 2: a directory where the stamp should be (open() fails,
+    # getmtime works)
+    os.makedirs(tmp_path / "hb-2")
+    assert health.dead_nodes(3, timeout=30.0) == []
+    # and a genuinely absent rank is still reported dead
+    assert health.dead_nodes(4, timeout=30.0) == [3]
+
+
+def test_heartbeat_stamp_fault_injection(tmp_path, monkeypatch):
+    """An injected stamp-write failure must neither kill construction
+    nor (transient) flip the rank dead: the beat thread keeps trying."""
+    from mxnet_tpu import faults
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    faults.configure("io_error@hb_stamp:beat=1:count=1")
+    try:
+        h = health.Heartbeat(7, interval=0.02)   # first beat injected
+        assert h.active
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if health.dead_nodes(8, timeout=30.0) == list(range(7)):
+                break
+            time.sleep(0.02)
+        # rank 7 recovered on a later beat despite the injected failure
+        assert 7 not in health.dead_nodes(8, timeout=30.0)
+        assert faults.fired("io_error") == 1
+        h.stop()
+    finally:
+        faults.clear()
+
+
+def test_heartbeat_registered_for_atexit_stop(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    h = health.Heartbeat(0, interval=0.05)
+    assert h in health._live_beats
+    assert h._thread.daemon                  # can never wedge exit
+    health._stop_all_at_exit()
+    assert not h.active

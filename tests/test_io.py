@@ -750,3 +750,47 @@ def test_device_cache_iter_shards_with_num_parts(tmp_path):
         seen.append(set(labels.astype(int).tolist()))
     assert seen[0].isdisjoint(seen[1])
     assert seen[0] | seen[1] == set(range(12))
+
+
+class _ExplodingSource(io.DataIter):
+    """Source whose next() dies mid-epoch (resilience satellite: the
+    prefetcher must hand the producer's error to the consumer instead of
+    stalling or ending the epoch silently)."""
+
+    def __init__(self, blow_at=2):
+        super().__init__(4)
+        self.n = 0
+        self.blow_at = blow_at
+        self.provide_data = [io.DataDesc("data", (4, 3))]
+        self.provide_label = [io.DataDesc("softmax_label", (4,))]
+
+    def next(self):
+        self.n += 1
+        if self.n == self.blow_at:
+            raise RuntimeError("decoder died on batch %d" % self.n)
+        if self.n > 5:
+            raise StopIteration
+        return io.DataBatch([mx.nd.array(np.full((4, 3), self.n, "f"))],
+                            [mx.nd.array(np.zeros(4, "f"))], pad=0)
+
+    def reset(self):
+        self.n = 0
+
+
+def test_prefetching_iter_producer_error_reaches_consumer():
+    pf = io.PrefetchingIter(_ExplodingSource(blow_at=2))
+    first = pf.next()                       # batch 1 was already staged
+    assert first.data[0].asnumpy()[0, 0] == 1
+    with pytest.raises(RuntimeError, match="decoder died on batch 2"):
+        pf.next()
+    # the error is a one-shot latch: reset rearms the stream
+    pf.reset()
+    assert pf.next().data[0].asnumpy()[0, 0] == 1
+
+
+def test_prefetching_iter_error_not_confused_with_epoch_end():
+    """An error at the FIRST production must raise, not read as an empty
+    epoch (next_batch[0] is None in both cases)."""
+    pf = io.PrefetchingIter(_ExplodingSource(blow_at=1))
+    with pytest.raises(RuntimeError, match="decoder died on batch 1"):
+        pf.next()

@@ -12,11 +12,15 @@ import time
 
 
 def compile_step(trainer, batch_vals, lr=0.1):
-    """Lower + compile the fused step for concrete batch values."""
+    """Lower + compile the fused step for concrete batch values.  With
+    the step sentinel armed the signature gains the sentinel-state arg
+    after opt_state (see Trainer._build)."""
     import jax.numpy as jnp
-    return trainer._step_fn.lower(
-        trainer.params, trainer.aux, trainer.opt_state, batch_vals,
-        jnp.float32(lr), jnp.int32(1), trainer._key).compile()
+    sent = getattr(trainer, "_sent", None)
+    args = (trainer.params, trainer.aux, trainer.opt_state)
+    args += (sent,) if sent is not None else ()
+    args += (batch_vals, jnp.float32(lr), jnp.int32(1), trainer._key)
+    return trainer._step_fn.lower(*args).compile()
 
 
 def cost_analysis(comp):
