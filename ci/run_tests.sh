@@ -129,6 +129,14 @@ stage "graph lint gate (trace-time, no device execution)"
 # prints the finding summary — docs/how_to/graph_lint.md
 python tools/graph_lint.py --check
 
+stage "overlapped stream input pipeline (2-process decode ring, chunked H2D)"
+# the multi-process decode ring + chunked staging + on-device augment
+# suite (2 decode worker processes / preprocess_threads=2, pinned to
+# the CPU backend).  HARD timeout: a deadlocked ring or queue must
+# FAIL this stage, not hang the suite — docs/how_to/perf.md
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_stream_pipeline.py -q
+
 stage "fault-injection suite (sentinel / crash-resume / io recovery)"
 # every recovery path driven on demand via MXTPU_FAULTS — step sentinel
 # skip/abort, SIGKILL-faithful torn-checkpoint resume (subprocess),
@@ -138,9 +146,11 @@ python -m pytest tests/test_resilience.py -q
 
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_resilience.py already ran as its own stage above
+# test_resilience.py and test_stream_pipeline.py already ran as their
+# own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_resilience.py \
+    --ignore=tests/test_stream_pipeline.py \
     ${PYTEST_MARK[@]+"${PYTEST_MARK[@]}"}
 
 stage "distributed (2-worker local launcher)"

@@ -373,9 +373,16 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
     trainer then pays ZERO upload wait inside ``step()``.
 
     ``depth`` bounds device-side staging memory (depth x batch bytes).
+    ``chunks`` splits each host batch into K row-chunks uploaded as K
+    separate ``device_put``\\ s into COMMITTED staging buffers and
+    reassembled on device (one concatenate — bit-identical to the
+    single-put result): on transports that pace uploads at the wire,
+    the serializer starts shipping chunk 0 while chunk 1 is still being
+    pinned, and the consumer-side reassembly runs on the accelerator.
     ``stats()`` reports where the worker's wall went — ``upload_s`` vs
-    ``source_s`` (inner-iterator wait) — so a pipeline benchmark can
-    attribute per-batch time to named stages.
+    ``source_s``/``decode_wait_s`` (inner-iterator wait) — plus the
+    consumer's view (``consumer_wait_s``, ``ready_ahead_frac``), so a
+    pipeline benchmark can attribute per-batch time to named stages.
 
     ``data_shardings`` / ``label_shardings`` may be lists of shardings
     OR zero-argument callables returning such lists: a callable is
@@ -388,8 +395,14 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
 
     _END = object()
 
+    # arrays below this size ship as ONE device_put even when chunking
+    # is on: splitting a 1 KB label vector into K dispatches plus an
+    # on-device concatenate costs latency for zero wire win
+    CHUNK_MIN_BYTES = 1 << 20
+
     def __init__(self, it, device=None, depth=2,
-                 data_shardings=None, label_shardings=None):
+                 data_shardings=None, label_shardings=None, chunks=1,
+                 chunk_min_bytes=None):
         super().__init__()
         self.it = it
         self.batch_size = getattr(it, "batch_size", 0)
@@ -397,12 +410,18 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         self._data_shardings = data_shardings
         self._label_shardings = label_shardings
         self._depth = max(1, int(depth))
+        self._chunks = max(1, int(chunks or 1))
+        self._chunk_min_bytes = self.CHUNK_MIN_BYTES \
+            if chunk_min_bytes is None else int(chunk_min_bytes)
         self._q = queue.Queue(self._depth)
         self._stop = threading.Event()
         self._err = None
         self.upload_s = 0.0
         self.source_s = 0.0
+        self.consumer_wait_s = 0.0
         self.batches_staged = 0
+        self._ready_hits = 0
+        self._next_calls = 0
         self._worker = None
         self._ended = False
         # the worker starts LAZILY on the first next(): a reset (or
@@ -466,7 +485,34 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         if isinstance(a, NDArray):
             return a                       # already device-resident
         placement = shardings[i] if shardings else self._device
-        return NDArray(jax.device_put(np.asarray(a), placement))
+        arr = np.asarray(a)
+        if self._chunks > 1 and arr.ndim > 0 \
+                and arr.shape[0] >= self._chunks \
+                and arr.nbytes >= self._chunk_min_bytes \
+                and self._chunkable(placement):
+            import jax.numpy as jnp
+            if placement is None:
+                # commit the staging buffers: an uncommitted chunk may
+                # be re-placed by the consumer, voiding the pipelining
+                placement = jax.devices()[0]
+            parts = [jax.device_put(p, placement)
+                     for p in np.array_split(arr, self._chunks, axis=0)]
+            return NDArray(jnp.concatenate(parts, axis=0))
+        return NDArray(jax.device_put(arr, placement))
+
+    @staticmethod
+    def _chunkable(placement):
+        """Chunk only single-device placements: row-splitting a batch
+        bound for a multi-device sharding would need per-chunk shard
+        arithmetic for no wire win (each device's shard already ships
+        as its own transfer)."""
+        import jax
+        if placement is None or isinstance(placement, jax.Device):
+            return True
+        try:
+            return len(placement.device_set) == 1
+        except Exception:                   # noqa: BLE001
+            return False
 
     def _put(self, item):
         while not self._stop.is_set():
@@ -505,12 +551,18 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         self._err = None      # a stale worker error must not resurface
 
     def next(self):
+        import time as _time
         if self._ended:                 # exhausted: repeatable, no hang
             raise StopIteration
         if self._worker is None or not (self._worker.is_alive()
                                         or self._q.qsize()):
             self._start_worker()
+        self._next_calls += 1
+        if self._q.qsize():
+            self._ready_hits += 1       # staged ahead of the ask
+        t0 = _time.perf_counter()
         item = self._q.get()
+        self.consumer_wait_s += _time.perf_counter() - t0
         if item is self._END:
             self._ended = True
             if self._err is not None:
@@ -528,11 +580,171 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
             return False
 
     def stats(self):
-        """Worker-side wall attribution: ``upload_s`` (device_put +
-        readiness wait) vs ``source_s`` (inner-iterator wait)."""
+        """Per-stage wall attribution.  Worker side: ``upload_s``
+        (device_put + readiness wait) vs ``source_s`` (aliased
+        ``decode_wait_s`` — blocked on the inner iterator).  Consumer
+        side: ``consumer_wait_s`` (blocked on the staging queue) and
+        ``ready_ahead_frac`` (fraction of ``next()`` calls served from
+        an already-staged batch — 1.0 means the pipeline ran fully
+        ahead of consumption)."""
         return {"upload_s": round(self.upload_s, 3),
                 "source_s": round(self.source_s, 3),
-                "batches_staged": self.batches_staged}
+                "decode_wait_s": round(self.source_s, 3),
+                "consumer_wait_s": round(self.consumer_wait_s, 3),
+                "ready_ahead_frac": round(
+                    self._ready_hits / self._next_calls, 3)
+                if self._next_calls else None,
+                "batches_staged": self.batches_staged,
+                "chunks": self._chunks,
+                "depth": self._depth}
+
+
+def _make_device_augment(crop, chans, rand_crop, rand_mirror, mean, std,
+                         gather):
+    """The jitted on-device augmentation program shared by
+    ``DeviceCacheIter`` (``gather=True``: batches are gathered out of
+    the HBM-resident cache by index) and ``StreamAugmentIter``
+    (``gather=False``: batches arrive whole from the upload stage):
+    random-or-center crop, random mirror, optional mean/std
+    normalization (emitting float32), all on the accelerator."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    ch, cw = crop
+
+    def _core(imgs, key):
+        B, H, W = imgs.shape[0], imgs.shape[1], imgs.shape[2]
+        kc, km = jax.random.split(key)
+        if rand_crop and (H > ch or W > cw):
+            oy = jax.random.randint(kc, (B,), 0, H - ch + 1)
+            ox = jax.random.randint(jax.random.fold_in(kc, 1),
+                                    (B,), 0, W - cw + 1)
+        else:
+            oy = jnp.full((B,), (H - ch) // 2)
+            ox = jnp.full((B,), (W - cw) // 2)
+        out = jax.vmap(
+            lambda im, y, x: lax.dynamic_slice(
+                im, (y, x, 0), (ch, cw, chans)))(imgs, oy, ox)
+        if rand_mirror:
+            flip = jax.random.bernoulli(km, 0.5, (B,))
+            out = jnp.where(flip[:, None, None, None],
+                            out[:, :, ::-1, :], out)
+        if mean is not None or std is not None:
+            out = out.astype(jnp.float32)
+            if mean is not None:
+                out = out - mean
+            if std is not None:
+                out = out / std
+        return out
+
+    if gather:
+        def augment(data, labels, idx, key):
+            return (_core(jnp.take(data, idx, axis=0), key),
+                    jnp.take(labels, idx, axis=0))
+    else:
+        def augment(imgs, labels, key):
+            return _core(imgs, key), labels
+    return jax.jit(augment)
+
+
+class StreamAugmentIter(_CurrentBatchAccessors, DataIter):
+    """On-device augmentation for the STREAMING input path: wraps an
+    iterator yielding uint8 NHWC frame batches (host numpy or already
+    device-resident, e.g. staged by :class:`DeviceUploadIter`) and runs
+    crop / mirror / normalize inside one jitted program on the
+    accelerator — the streaming sibling of ``DeviceCacheIter``'s
+    per-batch program (same ``_make_device_augment`` kernel).
+
+    Division of labor with the host decode stage (docs/how_to/perf.md
+    "Input pipeline"): augmentations that SHRINK the batch (crop)
+    belong before the wire — they reduce the bytes shipped — while
+    byte-neutral or byte-growing work (mirror, normalize, the float
+    cast) belongs here, after the wire, where it costs microseconds of
+    idle accelerator time instead of host CPU.  With ``data_shape``
+    smaller than the incoming frames this iterator also does the crop
+    (for hosts that want zero spatial work in the decode workers).
+    """
+
+    def __init__(self, inner, data_shape=None, rand_crop=False,
+                 rand_mirror=False, mean=None, std=None, seed=0,
+                 device=None):
+        import jax
+        super().__init__(getattr(inner, "batch_size", 0))
+        self.it = inner
+        self._device = device
+        desc = inner.provide_data[0]
+        if len(desc.shape) != 4:
+            raise MXNetError(
+                "StreamAugmentIter expects NHWC frame batches, got "
+                "shape %s from %s" % (desc.shape, type(inner).__name__))
+        _, H, W, C = desc.shape
+        if data_shape is None:
+            ch, cw = int(H), int(W)
+        else:
+            ch, cw = int(data_shape[-2]), int(data_shape[-1])
+        if ch > H or cw > W:
+            raise MXNetError("crop %s exceeds incoming frames %s"
+                             % ((ch, cw), (H, W)))
+        for what, v in (("mean", mean), ("std", std)):
+            if v is not None and np.asarray(v).size not in (1, int(C)):
+                raise MXNetError(
+                    "%s has %d entries but frames have %d channels"
+                    % (what, np.asarray(v).size, C))
+        self._crop = (ch, cw)
+        self._chans = int(C)
+        self._in_dtype = desc.dtype
+        self._mean = None if mean is None else np.asarray(mean, np.float32)
+        self._std = None if std is None else np.asarray(std, np.float32)
+        self._aug = _make_device_augment(
+            self._crop, self._chans, bool(rand_crop), bool(rand_mirror),
+            self._mean, self._std, gather=False)
+        self._key = jax.random.key(seed)
+
+    @property
+    def provide_data(self):
+        desc = self.it.provide_data[0]
+        out_t = np.float32 if (self._mean is not None
+                               or self._std is not None) else desc.dtype
+        ch, cw = self._crop
+        return [DataDesc(desc.name, (desc.shape[0], ch, cw, self._chans),
+                         out_t)]
+
+    @property
+    def provide_label(self):
+        return self.it.provide_label
+
+    def reset(self):
+        self.it.reset()
+
+    def stats(self):
+        inner = getattr(self.it, "stats", None)
+        return inner() if callable(inner) else {}
+
+    def next(self):
+        import jax
+        b = self.it.next()
+        imgs = b.data[0]
+        imgs = imgs.data if isinstance(imgs, NDArray) \
+            else jax.device_put(np.asarray(imgs), self._device)
+        lbl = b.label[0] if b.label else None
+        if isinstance(lbl, NDArray):
+            lbl = lbl.data
+        self._key, sub = jax.random.split(self._key)
+        out, lbl_out = self._aug(imgs, lbl, sub)
+        self.current_batch = DataBatch(
+            data=[NDArray(out)],
+            label=[NDArray(lbl_out)] if lbl is not None else [],
+            pad=b.pad, index=b.index,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+        return self.current_batch
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            return False
 
 
 class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
@@ -616,41 +828,9 @@ class DeviceCacheIter(_CurrentBatchAccessors, DataIter):
             self._rng.shuffle(self._order)
 
     def _build_augment(self):
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-        ch, cw = self._crop
-        chans = int(self._data.shape[-1])
-        rand_crop, rand_mirror = self.rand_crop, self.rand_mirror
-        mean, std = self._mean, self._std
-
-        def augment(data, labels, idx, key):
-            imgs = jnp.take(data, idx, axis=0)          # [B, H, W, C]
-            B, H, W = imgs.shape[0], imgs.shape[1], imgs.shape[2]
-            kc, km = jax.random.split(key)
-            if rand_crop and (H > ch or W > cw):
-                oy = jax.random.randint(kc, (B,), 0, H - ch + 1)
-                ox = jax.random.randint(jax.random.fold_in(kc, 1),
-                                        (B,), 0, W - cw + 1)
-            else:
-                oy = jnp.full((B,), (H - ch) // 2)
-                ox = jnp.full((B,), (W - cw) // 2)
-            crop = jax.vmap(
-                lambda im, y, x: lax.dynamic_slice(
-                    im, (y, x, 0), (ch, cw, chans)))(imgs, oy, ox)
-            if rand_mirror:
-                flip = jax.random.bernoulli(km, 0.5, (B,))
-                crop = jnp.where(flip[:, None, None, None],
-                                 crop[:, :, ::-1, :], crop)
-            if mean is not None or std is not None:
-                crop = crop.astype(jnp.float32)
-                if mean is not None:
-                    crop = crop - mean
-                if std is not None:
-                    crop = crop / std
-            return crop, jnp.take(labels, idx, axis=0)
-
-        return jax.jit(augment)
+        return _make_device_augment(
+            self._crop, int(self._data.shape[-1]), self.rand_crop,
+            self.rand_mirror, self._mean, self._std, gather=True)
 
     @property
     def provide_data(self):
@@ -971,14 +1151,255 @@ def _as_shape(s):
     return tuple(int(x) for x in s)
 
 
+def _shard_contiguous(items, num_parts, part_index):
+    """Contiguous ``num_parts`` sharding with the remainder spread over
+    the first parts — every record lands in exactly one part.  (The old
+    ``len // num_parts`` truncation silently dropped the remainder
+    records from every worker's epoch.)"""
+    if num_parts <= 1:
+        return list(items)
+    if not 0 <= part_index < num_parts:
+        raise MXNetError("part_index %d out of range for num_parts %d"
+                         % (part_index, num_parts))
+    base, rem = divmod(len(items), num_parts)
+    start = part_index * base + min(part_index, rem)
+    stop = start + base + (1 if part_index < rem else 0)
+    return list(items[start:stop])
+
+
+class _RemoteDecodeTraceback(Exception):
+    """Carries a decode worker's formatted traceback as the
+    ``__cause__`` of the re-raised original exception (the
+    ``multiprocessing.pool`` RemoteTraceback pattern): the consumer
+    sees the worker-side stack, not just the parent's re-raise site."""
+
+    def __init__(self, tb):
+        super().__init__("\n--- decode worker traceback ---\n%s" % tb)
+
+
+class _ProcessDecodeRing:
+    """Parent-side controller of the multi-process decode ring
+    (``_decode_worker.worker_main`` holds the child-side protocol
+    spec).  Each worker owns a ``depth``-slot shared-memory slab ring;
+    batches are assigned round-robin (worker ``w`` decodes batches
+    ``w, w+W, ...``), the parent reassembles global batch order from
+    the tagged results, copies each slab out the moment it arrives
+    (so workers run ahead regardless of consumer cadence), and bounds
+    host memory at ``workers x depth`` batch slabs.
+
+    ``submit_epoch`` invalidates in-flight work by bumping the shared
+    epoch value — a mid-epoch ``reset()`` needs no teardown, no
+    respawn, and cannot deadlock (workers parked on a full ring
+    re-check the epoch).  ``close`` joins the workers and unlinks every
+    shared-memory slab."""
+
+    def __init__(self, rec_path, slab_shape, label_width, workers, depth,
+                 resize, rand_crop, rand_mirror, seed, crop,
+                 start_method=None):
+        import multiprocessing as mp
+        from multiprocessing import shared_memory
+        from . import _decode_worker
+        start_method = start_method or os.environ.get(
+            "MXTPU_DECODE_START_METHOD", "spawn")
+        self._ctx = mp.get_context(start_method)
+        self._closed = False
+        self._workers = []
+        self._stash = {}
+        self._expected = 0
+        self._next_seq = 0
+        self._delivered = 0
+        self._epoch = 0
+        self._depth = max(1, int(depth))
+        self._slab_shape = tuple(int(s) for s in slab_shape)
+        self._result_q = self._ctx.Queue()
+        self._epoch_val = self._ctx.Value("i", 0)
+        nbytes = int(np.prod(self._slab_shape)) * self._depth
+        try:
+            for wid in range(max(1, int(workers))):
+                shm = shared_memory.SharedMemory(create=True, size=nbytes)
+                try:
+                    task_q = self._ctx.Queue()
+                    sem = self._ctx.Semaphore(self._depth)
+                    cfg = {"wid": wid, "rec_path": rec_path,
+                           "shm_name": shm.name, "depth": self._depth,
+                           "slab_shape": self._slab_shape,
+                           "label_width": int(label_width),
+                           "resize": int(resize), "crop": tuple(crop),
+                           "rand_crop": bool(rand_crop),
+                           "rand_mirror": bool(rand_mirror),
+                           "seed": int(seed)}
+                    proc = self._ctx.Process(
+                        target=_decode_worker.worker_main,
+                        args=(cfg, task_q, self._result_q, sem,
+                              self._epoch_val),
+                        daemon=True, name="mxtpu-decode-%d" % wid)
+                    proc.start()
+                    view = np.ndarray((self._depth,) + self._slab_shape,
+                                      dtype=np.uint8, buffer=shm.buf)
+                except BaseException:
+                    # this wid's segment is in no _workers entry yet —
+                    # close() below would never reach it
+                    shm.close()
+                    shm.unlink()
+                    raise
+                self._workers.append({"proc": proc, "shm": shm,
+                                      "task_q": task_q, "sem": sem,
+                                      "view": view})
+        except BaseException:
+            self.close()
+            raise
+
+    # ------------------------------------------------------------------
+    def submit_epoch(self, batches):
+        """Assign one epoch of ``(offsets, pad, indices)`` batch tasks
+        round-robin over the workers.  Implicitly invalidates any
+        in-flight work from the previous epoch."""
+        self._epoch += 1
+        with self._epoch_val.get_lock():
+            self._epoch_val.value = self._epoch
+        # stale in-flight results are drained lazily by next_batch
+        # (each releases its ring slot there)
+        self._stash.clear()
+        self._expected = len(batches)
+        self._next_seq = 0
+        self._delivered = 0
+        W = len(self._workers)
+        for seq, (offsets, pad, idxs) in enumerate(batches):
+            self._workers[seq % W]["task_q"].put(
+                (self._epoch, seq, list(offsets), int(pad),
+                 np.asarray(idxs)))
+
+    def _receive(self, deadline, timeout):
+        import time as _time
+        while True:
+            try:
+                return self._result_q.get(timeout=0.2)
+            except queue.Empty:
+                dead = [w["proc"].name for w in self._workers
+                        if not w["proc"].is_alive()]
+                if dead:
+                    raise MXNetError(
+                        "decode worker(s) %s died without reporting — "
+                        "ring aborted" % ", ".join(dead))
+                if _time.monotonic() > deadline:
+                    raise MXNetError(
+                        "decode ring stalled: no batch within %.0f s "
+                        "(epoch %d, waiting for batch %d of %d)"
+                        % (timeout, self._epoch, self._next_seq,
+                           self._expected))
+
+    def next_batch(self, timeout=300.0):
+        """The next in-order decoded batch as ``(uint8 NHWC data,
+        labels, pad, indices)``, or ``None`` at epoch end.  A batch
+        whose decode failed re-raises the worker's ORIGINAL exception,
+        its child-side formatted traceback attached as ``__cause__``;
+        the stream continues past it on the following call."""
+        import time as _time
+        if self._delivered >= self._expected:
+            return None
+        deadline = _time.monotonic() + timeout
+        while self._next_seq not in self._stash:
+            msg = self._receive(deadline, timeout)
+            kind, wid, epoch, seq = msg[0], msg[1], msg[2], msg[3]
+            w = self._workers[wid]
+            if kind == "ok":
+                slot, labels, pad, idxs = msg[4], msg[5], msg[6], msg[7]
+                if epoch != self._epoch:
+                    w["sem"].release()      # stale: just recycle the slot
+                    continue
+                # copy the slab out IMMEDIATELY and free the slot — the
+                # worker runs ahead regardless of consumer cadence
+                data = np.array(w["view"][slot])
+                w["sem"].release()
+                self._stash[seq] = ("ok", (data, labels, pad, idxs))
+            else:
+                exc, tb = msg[4], msg[5]
+                if epoch != self._epoch:
+                    continue               # slot was returned worker-side
+                self._stash[seq] = ("err", (exc, tb))
+        kind, payload = self._stash.pop(self._next_seq)
+        self._next_seq += 1
+        self._delivered += 1
+        if kind == "err":
+            exc, tb = payload
+            raise exc from _RemoteDecodeTraceback(tb)
+        return payload
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            with self._epoch_val.get_lock():
+                self._epoch_val.value = -1  # parked workers bail out
+        except Exception:                   # noqa: BLE001
+            pass
+        for w in self._workers:
+            try:
+                w["task_q"].put(None)
+            except Exception:               # noqa: BLE001
+                pass
+        for w in self._workers:
+            w["proc"].join(timeout=5.0)
+            if w["proc"].is_alive():
+                w["proc"].terminate()
+                w["proc"].join(timeout=2.0)
+        try:                # free the feeder thread before closing
+            while True:
+                self._result_q.get_nowait()
+        except (queue.Empty, OSError, ValueError):
+            pass
+        self._result_q.close()
+        for w in self._workers:
+            try:
+                w["task_q"].close()
+            except Exception:               # noqa: BLE001
+                pass
+            w["view"] = None               # release the exported buffer
+            w["shm"].close()
+            try:
+                w["shm"].unlink()
+            except FileNotFoundError:
+                pass
+        self._workers = []
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:                   # noqa: BLE001
+            pass
+
+
 class PyImageRecordIter(DataIter):
-    """RecordIO image iterator with threaded decode + augmentation.
+    """RecordIO image iterator with threaded OR multi-process decode.
 
     Python-native equivalent of ``src/io/iter_image_recordio_2.cc:28-120``
     (parser with OMP decode threads) + ``image_aug_default.cc`` (resize,
     random/center crop, mirror, HSL jitter) + normalize/batch/prefetch
-    stages.  Decode parallelism = ``preprocess_threads``; a producer thread
-    double-buffers ready batches so device steps overlap decode.
+    stages.
+
+    ``preprocess_mode`` selects the decode engine:
+
+    * ``"thread"`` (default, the ``preprocess_threads``-compatible
+      path): a ``ThreadPoolExecutor`` decode pool + a producer thread
+      double-buffering ready batches.  GIL-bound — PIL decode releases
+      the GIL only partially and the float normalize/transpose never
+      does — but works everywhere and keeps the reference float-CHW
+      output contract.
+    * ``"process"``: ``decode_workers`` (default ``preprocess_threads``)
+      spawned worker processes (``_decode_worker.worker_main``), each
+      seeking its own slice of the RecordIO by byte offset and decoding
+      JPEG → **uint8 NHWC** into a ``multiprocessing.shared_memory``
+      ring of ``prefetch_buffer`` batch slabs — true decode
+      parallelism, no GIL.  Color math (normalize/scale) is refused
+      here by design: raw bytes cross the wire and the jitted consumer
+      (``StreamAugmentIter`` / the fused trainer's on-device cast)
+      finishes the pipeline on the accelerator.  Falls back to spawn's
+      semantics everywhere; on spawn-hostile platforms use
+      ``"thread"``.
+
+    ``output="numpy"`` keeps batches host-side (the staging pipeline's
+    contract: exactly one H2D crossing, owned by ``DeviceUploadIter``).
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size,
@@ -990,14 +1411,24 @@ class PyImageRecordIter(DataIter):
                  max_aspect_ratio=0.0, random_h=0, random_s=0, random_l=0,
                  preprocess_threads=4, prefetch_buffer=4, part_index=0,
                  num_parts=1, round_batch=True, seed=0, data_name="data",
-                 label_name="softmax_label", **kwargs):
+                 label_name="softmax_label", preprocess_mode="thread",
+                 decode_workers=None, output="ndarray", **kwargs):
         super().__init__(int(batch_size))
         self.data_shape = _as_shape(data_shape)
         assert len(self.data_shape) == 3, "data_shape must be (c, h, w)"
+        if preprocess_mode not in ("thread", "process"):
+            raise MXNetError("preprocess_mode must be thread or process, "
+                             "got %r" % (preprocess_mode,))
+        if output not in ("ndarray", "numpy"):
+            raise MXNetError("output must be ndarray or numpy, got %r"
+                             % (output,))
+        self.preprocess_mode = preprocess_mode
+        self.output = output
         self.label_width = int(label_width)
         self.shuffle = _parse_bool(shuffle)
         self.rand_crop = _parse_bool(rand_crop)
         self.rand_mirror = _parse_bool(rand_mirror)
+        self.round_batch = _parse_bool(round_batch)
         self.scale = float(scale)
         self.resize = int(resize)
         self.mean = None
@@ -1010,22 +1441,48 @@ class PyImageRecordIter(DataIter):
                                   float(mean_r)]).reshape(3, 1, 1)
         self.std = np.array([float(std_b), float(std_g),
                              float(std_r)]).reshape(3, 1, 1)
+        if self.preprocess_mode == "process":
+            if type(self) is not PyImageRecordIter:
+                raise MXNetError(
+                    "preprocess_mode='process' supports plain image "
+                    "records only (%s overrides the decode hook; use "
+                    "thread mode)" % type(self).__name__)
+            if self.mean is not None or self.scale != 1.0 or \
+                    not np.all(self.std == 1.0):
+                raise MXNetError(
+                    "preprocess_mode='process' ships raw uint8 NHWC: "
+                    "mean/std/scale must be identity — normalize on "
+                    "device instead (StreamAugmentIter or the fused "
+                    "trainer's cast)")
         self.data_name = data_name
         self.label_name = label_name
-        self.rng = np.random.RandomState(int(seed))
+        self._seed = int(seed)
+        self.rng = np.random.RandomState(self._seed)
 
+        self._rec_path = path_imgrec
         self._record = _recordio.MXIndexedRecordIO(
             path_imgidx or os.path.splitext(path_imgrec)[0] + ".idx",
             path_imgrec, "r") if (path_imgidx or os.path.isfile(
                 os.path.splitext(path_imgrec)[0] + ".idx")) \
             else _recordio.MXRecordIO(path_imgrec, "r")
-        # scan record offsets once so shuffle/sharding can seek
-        self._offsets = self._scan_offsets(path_imgrec)
-        n = len(self._offsets) // int(num_parts)
-        self._offsets = self._offsets[int(part_index) * n:
-                                      (int(part_index) + 1) * n]
+        if isinstance(self._record, _recordio.MXIndexedRecordIO) \
+                and self._record.keys:
+            # the .idx sidecar already maps every record to its byte
+            # offset — no sequential re-read of the whole .rec
+            self._offsets = self._record.offsets()
+        else:
+            self._offsets = self._scan_offsets(path_imgrec)
+        self._offsets = _shard_contiguous(self._offsets, int(num_parts),
+                                          int(part_index))
         self._order = np.arange(len(self._offsets))
-        self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads))
+        self._ring = None
+        self._ring_depth = max(2, int(prefetch_buffer))
+        self._decode_workers = max(1, int(decode_workers
+                                          or preprocess_threads or 1))
+        self._pool = None
+        if self.preprocess_mode == "thread":
+            self._pool = ThreadPoolExecutor(
+                max_workers=int(preprocess_threads))
         self._queue: "queue.Queue" = queue.Queue(maxsize=int(prefetch_buffer))
         self._producer = None
         self._stop = threading.Event()
@@ -1034,27 +1491,18 @@ class PyImageRecordIter(DataIter):
 
     @staticmethod
     def _scan_offsets(path):
-        offsets = []
-        with open(path, "rb") as f:
-            size = os.fstat(f.fileno()).st_size
-            pos = 0
-            while pos < size:
-                offsets.append(pos)
-                while True:
-                    head = f.read(8)
-                    if len(head) < 8:
-                        pos = size
-                        break
-                    magic, lrec = struct.unpack("<II", head)
-                    cflag, length = _decode_lrec_mod(lrec)
-                    f.seek(length + ((-length) % 4), 1)
-                    pos = f.tell()
-                    if cflag in (0, 3):
-                        break
-        return offsets
+        """Sequential full-file scan — the fallback when no ``.idx``
+        sidecar exists (the indexed path reads the offsets straight
+        from ``MXIndexedRecordIO.offsets()``)."""
+        from . import _decode_worker
+        return _decode_worker.scan_offsets(path)
 
     @property
     def provide_data(self):
+        if self.preprocess_mode == "process":
+            c, h, w = self.data_shape
+            return [DataDesc(self.data_name,
+                             (self.batch_size, h, w, c), np.uint8)]
         return [DataDesc(self.data_name,
                          (self.batch_size,) + self.data_shape)]
 
@@ -1065,14 +1513,69 @@ class PyImageRecordIter(DataIter):
         return [DataDesc(self.label_name, shape)]
 
     # -- producer pipeline ---------------------------------------------
+    def _epoch_batches(self):
+        """The epoch's batch plan: ``(record_indices, pad)`` per batch.
+        ``round_batch=True`` wraps the ragged tail from the epoch start
+        (reporting ``pad``); ``False`` drops it — the same mapping
+        ``CSVIter`` applies (pad vs discard)."""
+        bs = self.batch_size
+        out = []
+        for i in range(0, len(self._order), bs):
+            idxs = self._order[i:i + bs]
+            pad = bs - len(idxs)
+            if pad > 0:
+                if not self.round_batch:
+                    break
+                # modular wrap: a dataset smaller than the pad still
+                # fills every slot (plain self._order[:pad] came up
+                # short and underfilled the batch)
+                idxs = np.concatenate([
+                    idxs, np.take(self._order, np.arange(pad),
+                                  mode="wrap")])
+            out.append((idxs, pad))
+        return out
+
     def reset(self):
-        self._drain()
         if self.shuffle:
             self.rng.shuffle(self._order)
         self._epoch_done = False
+        if self.preprocess_mode == "process":
+            if self._ring is None:
+                c, h, w = self.data_shape
+                self._ring = _ProcessDecodeRing(
+                    rec_path=self._rec_path,
+                    slab_shape=(self.batch_size, h, w, c),
+                    label_width=self.label_width,
+                    workers=self._decode_workers,
+                    depth=self._ring_depth, resize=self.resize,
+                    rand_crop=self.rand_crop,
+                    rand_mirror=self.rand_mirror, seed=self._seed,
+                    crop=(h, w))
+            self._ring.submit_epoch(
+                [([self._offsets[j] for j in idxs], pad, idxs.copy())
+                 for idxs, pad in self._epoch_batches()])
+            return
+        self._drain()
         self._stop.clear()
         self._producer = threading.Thread(target=self._produce, daemon=True)
         self._producer.start()
+
+    def close(self):
+        """Tear down the process-mode decode ring (worker processes +
+        shared-memory slabs).  Idempotent; also runs at GC.  Thread
+        mode needs no explicit teardown (its daemon producer dies with
+        the process — joining it from a GC-time finalizer risks the
+        CPython-3.10 shutdown stall the PrefetchingIter ``__del__``
+        note describes)."""
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:                   # noqa: BLE001
+            pass
 
     def _drain(self):
         if self._producer is not None:
@@ -1101,34 +1604,12 @@ class PyImageRecordIter(DataIter):
         return self._augment(img), label
 
     def _augment(self, img):
-        """resize -> random-scale -> crop -> mirror -> normalize; CHW out."""
-        from PIL import Image
+        """resize -> crop -> mirror (the shared spatial stage) ->
+        normalize; CHW float out."""
+        from ._decode_worker import spatial_augment
         c, h, w = self.data_shape
-        if img.ndim == 2:
-            img = np.stack([img] * 3, axis=2)
-        if self.resize > 0:
-            ih, iw = img.shape[:2]
-            short = min(ih, iw)
-            ratio = self.resize / short
-            pil = Image.fromarray(img[:, :, ::-1])
-            pil = pil.resize((max(w, int(iw * ratio)),
-                              max(h, int(ih * ratio))), Image.BILINEAR)
-            img = np.asarray(pil)[:, :, ::-1]
-        ih, iw = img.shape[:2]
-        if ih < h or iw < w:
-            pil = Image.fromarray(img[:, :, ::-1])
-            pil = pil.resize((max(w, iw), max(h, ih)), Image.BILINEAR)
-            img = np.asarray(pil)[:, :, ::-1]
-            ih, iw = img.shape[:2]
-        if self.rand_crop:
-            y = self.rng.randint(0, ih - h + 1)
-            x = self.rng.randint(0, iw - w + 1)
-        else:
-            y = (ih - h) // 2
-            x = (iw - w) // 2
-        img = img[y:y + h, x:x + w]
-        if self.rand_mirror and self.rng.rand() < 0.5:
-            img = img[:, ::-1]
+        img = spatial_augment(img, h, w, self.resize, self.rand_crop,
+                              self.rand_mirror, self.rng)
         chw = img.transpose(2, 0, 1).astype(np.float32)
         if self.mean is not None:
             chw = chw - self.mean
@@ -1144,13 +1625,9 @@ class PyImageRecordIter(DataIter):
 
     def _produce_impl(self):
         bs = self.batch_size
-        n = len(self._order)
-        i = 0
-        while i < n and not self._stop.is_set():
-            idxs = self._order[i:i + bs]
-            pad = bs - len(idxs)
-            if pad > 0:
-                idxs = np.concatenate([idxs, self._order[:pad]])
+        for idxs, pad in self._epoch_batches():
+            if self._stop.is_set():
+                return
             raws = [self._read_record(self._offsets[j]) for j in idxs]
             decoded = list(self._pool.map(self._decode_one, raws))
             data = np.stack([d for d, _ in decoded])
@@ -1166,10 +1643,11 @@ class PyImageRecordIter(DataIter):
                     continue
             if self._stop.is_set():
                 return
-            i += bs
         self._queue.put(None)
 
     def next(self):
+        if self.preprocess_mode == "process":
+            return self._next_process()
         item = self._queue.get()
         if item is None:
             self._epoch_done = True
@@ -1177,6 +1655,25 @@ class PyImageRecordIter(DataIter):
         if isinstance(item, BaseException):
             raise item
         data, labels, pad, idxs = item
+        if self.output == "numpy":
+            return DataBatch(data=[data], label=[labels],
+                             pad=pad, index=idxs)
+        return DataBatch(data=[array(data)], label=[array(labels)],
+                         pad=pad, index=idxs)
+
+    def _next_process(self):
+        if self._epoch_done:
+            raise StopIteration
+        item = self._ring.next_batch()
+        if item is None:
+            self._epoch_done = True
+            raise StopIteration
+        data, labels, pad, idxs = item
+        if self.label_width == 1:
+            labels = labels.reshape(self.batch_size)
+        if self.output == "numpy":
+            return DataBatch(data=[data], label=[labels],
+                             pad=pad, index=idxs)
         return DataBatch(data=[array(data)], label=[array(labels)],
                          pad=pad, index=idxs)
 
@@ -1351,7 +1848,8 @@ class NativeImageRecordIter(DataIter):
 _PY_ONLY_DEFAULTS = {"mean_img": None, "max_random_scale": 1.0,
                      "min_random_scale": 1.0, "max_rotate_angle": 0,
                      "max_aspect_ratio": 0.0, "random_h": 0,
-                     "random_s": 0, "random_l": 0, "round_batch": True}
+                     "random_s": 0, "random_l": 0, "round_batch": True,
+                     "preprocess_mode": "thread", "decode_workers": None}
 
 
 # leading positional parameters (the python class's order) — normalized

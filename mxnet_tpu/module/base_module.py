@@ -253,11 +253,13 @@ class BaseModule(object):
         Multi-host feeding stays synchronous
         (``make_array_from_process_local_data`` is a collective); opt
         out with ``MXTPU_UPLOAD_OVERLAP=0`` (or force on with ``=1``).
-        Defaults OFF on single-core hosts: there the decode pool, the
-        staging thread, and the transport's serializer fight for the
-        one core, and on the serialized tunnel transport a staging
-        thread cannot overlap the wire anyway (measured — perf.md
-        "Input pipeline")."""
+        ``MXTPU_UPLOAD_DEPTH`` (default 2) bounds the device staging
+        buffers; ``MXTPU_UPLOAD_CHUNKS`` (default 1) splits each host
+        batch into K chunked async device_puts (perf.md "Input
+        pipeline").  Defaults OFF on single-core hosts: there the
+        decode pool, the staging thread, and the transport's serializer
+        fight for the one core — the bench's streaming config enables
+        it explicitly because its wire wait releases the GIL."""
         import os
         from ..io import DeviceUploadIter
         tr = getattr(self, "_trainer", None)
@@ -281,9 +283,12 @@ class BaseModule(object):
                     else None
             return resolve
 
-        return DeviceUploadIter(train_data,
-                                data_shardings=_sh(self._data_names),
-                                label_shardings=_sh(self._label_names))
+        return DeviceUploadIter(
+            train_data,
+            depth=int(os.environ.get("MXTPU_UPLOAD_DEPTH", "2") or 2),
+            chunks=int(os.environ.get("MXTPU_UPLOAD_CHUNKS", "1") or 1),
+            data_shardings=_sh(self._data_names),
+            label_shardings=_sh(self._label_names))
 
     def _train_epoch(self, epoch, train_data, eval_metric,
                      batch_end_callback, monitor):

@@ -129,7 +129,8 @@ class Trainer:
                  sentinel: Optional[str] = None,
                  loss_scale=None,
                  sentinel_max_skips: Optional[int] = None,
-                 ls_growth_interval: Optional[int] = None):
+                 ls_growth_interval: Optional[int] = None,
+                 donate_batch: Optional[bool] = None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -205,6 +206,19 @@ class Trainer:
             else _os.environ.get("MXTPU_LS_GROWTH_INTERVAL",
                                  str(_LS_GROWTH_INTERVAL)))
         self._sent = None          # device sentinel state, see _init_sentinel
+        # staging-buffer donation (docs/how_to/perf.md "Input
+        # pipeline"): donate the batch argument so the uint8 staging
+        # buffers a DeviceUploadIter parked in HBM are freed the moment
+        # the step's on-device cast consumes them — device-side input
+        # memory stays bounded at depth x batch bytes instead of
+        # depth + in-flight.  OPT-IN: a caller that re-feeds the same
+        # device arrays every step (synthetic benches) or reads batch
+        # members after the step (Module.update_metric reads labels)
+        # must keep it off.
+        if donate_batch is None:
+            donate_batch = _os.environ.get("MXTPU_DONATE_BATCH",
+                                           "0") in ("1", "true", "yes")
+        self.donate_batch = bool(donate_batch)
         self.param_specs = param_specs or {}
         input_set = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in self.prog.arg_names
@@ -515,13 +529,15 @@ class Trainer:
                     step_sentinel,
                     in_shardings=(p_shard, a_shard, None, None,
                                   self._batch_shardings, None, None, None),
-                    donate_argnums=(0, 1, 2, 3))
+                    donate_argnums=(0, 1, 2, 3) + (
+                        (4,) if self.donate_batch else ()))
             else:
                 self._step_fn = jax.jit(
                     step,
                     in_shardings=(p_shard, a_shard, None,
                                   self._batch_shardings, None, None, None),
-                    donate_argnums=(0, 1, 2))
+                    donate_argnums=(0, 1, 2) + (
+                        (3,) if self.donate_batch else ()))
             self._eval_fn = jax.jit(
                 evaluate,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
@@ -530,10 +546,15 @@ class Trainer:
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
         else:
             if sentinel_on:
-                self._step_fn = jax.jit(step_sentinel,
-                                        donate_argnums=(0, 1, 2, 3))
+                self._step_fn = jax.jit(
+                    step_sentinel,
+                    donate_argnums=(0, 1, 2, 3) + (
+                        (4,) if self.donate_batch else ()))
             else:
-                self._step_fn = jax.jit(step, donate_argnums=(0, 1, 2))
+                self._step_fn = jax.jit(
+                    step,
+                    donate_argnums=(0, 1, 2) + (
+                        (3,) if self.donate_batch else ()))
             self._eval_fn = jax.jit(evaluate)
             self._eval_train_fn = jax.jit(evaluate_train)
 
@@ -554,7 +575,14 @@ class Trainer:
             else:
                 v = jnp.asarray(np.asarray(v))
             if self._batch_shardings is not None:
-                v = jax.device_put(v, self._batch_shardings[n])
+                want = self._batch_shardings[n]
+                # a batch the staging pipeline already committed to the
+                # right sharding (DeviceUploadIter resolves the
+                # trainer's shardings per batch) passes through — no
+                # second device_put dispatch per input per step
+                if not (isinstance(v, jax.Array)
+                        and getattr(v, "sharding", None) == want):
+                    v = jax.device_put(v, want)
             out[n] = v
         return out
 

@@ -215,6 +215,15 @@ class MXIndexedRecordIO(MXRecordIO):
                     fout.write("%s\t%d\n" % (str(key), self.idx[key]))
         super().close()
 
+    def offsets(self):
+        """Record start offsets in FILE order, straight from the
+        loaded index — what ``PyImageRecordIter`` uses instead of
+        re-scanning the whole ``.rec`` when a sidecar exists.  Sorted
+        by byte offset (keys are stored in write order, which for a
+        well-formed sidecar is the same thing; sorting makes the
+        contract explicit)."""
+        return sorted(self.idx[k] for k in self.keys)
+
     def seek(self, idx):
         self.seek_to(self.idx[idx])
 

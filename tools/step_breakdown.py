@@ -36,6 +36,14 @@ Round-6 additions:
   regression (the nightly CI gate); ``--write-budget`` ratchets the
   budget down after an intentional byte win.  ``--artifact-dir`` drops
   the layer-attributed breakdown there for CI upload.
+
+Round-7 addition: **input-overlap attribution**
+(:func:`overlap_attribution`, CLI ``--overlap``) — the host->device
+feed side of the same accounting.  The streaming pipeline's bound is
+``max(decode, h2d, compute)`` per batch, not their sum; bench.py
+computes these fields live (``stream_bound_img_per_sec``,
+``stream_overlap_efficiency``) from this one formula so the bench line
+and the tool can never disagree.
 """
 import json
 import os
@@ -506,6 +514,62 @@ def capture(batch=256, image=224, measure=True, steps=40, ctx=None):
 
 
 # ----------------------------------------------------------------------
+# input-pipeline overlap attribution (the stream half of the step
+# accounting: the byte budget covers on-chip HBM traffic, this covers
+# the host->device feed that must hide UNDER the step)
+def overlap_attribution(decode_s, h2d_s, compute_s, measured_s=None):
+    """Model of the overlapped streaming input pipeline (decode ring ->
+    chunked uploader -> on-device augment -> fused step): a perfectly
+    overlapped pipeline runs each batch in ``max`` of its stage times,
+    a fully serialized one in their ``sum``.
+
+    Returns per-batch seconds plus, when ``measured_s`` is given:
+
+    * ``overlap_efficiency`` = bound / measured — 1.0 means every
+      non-binding stage is fully hidden under the binding one; the
+      serialized pipeline reads bound/sum.
+    * ``exposed_s_per_batch`` — wall NOT hidden under the binding
+      stage (what an optimization must attack next).
+    * ``hidden_s_per_batch`` — overlap actually achieved vs the
+      serialized baseline.
+    """
+    stages = {"decode": float(decode_s), "h2d": float(h2d_s),
+              "compute": float(compute_s)}
+    bound_s = max(stages.values())
+    serial_s = sum(stages.values())
+    out = {"decode_s_per_batch": round(stages["decode"], 4),
+           "h2d_s_per_batch": round(stages["h2d"], 4),
+           "compute_s_per_batch": round(stages["compute"], 4),
+           "bound_s_per_batch": round(bound_s, 4),
+           "serial_s_per_batch": round(serial_s, 4),
+           "binding_stage": max(stages, key=stages.get)}
+    if measured_s:
+        measured_s = float(measured_s)
+        out["measured_s_per_batch"] = round(measured_s, 4)
+        out["overlap_efficiency"] = round(bound_s / measured_s, 3)
+        out["exposed_s_per_batch"] = round(measured_s - bound_s, 4)
+        out["hidden_s_per_batch"] = round(
+            max(0.0, serial_s - measured_s), 4)
+    return out
+
+
+def _parse_overlap_arg(spec):
+    """``decode=0.26,h2d=0.71,compute=0.09[,measured=0.77]`` -> kwargs."""
+    vals = {}
+    for item in spec.split(","):
+        key, eq, v = item.partition("=")
+        if not eq:
+            raise ValueError("bad overlap item %r (want key=seconds)"
+                             % item)
+        vals[key.strip()] = float(v)
+    missing = {"decode", "h2d", "compute"} - set(vals)
+    if missing:
+        raise ValueError("overlap spec missing %s" % sorted(missing))
+    return overlap_attribution(vals["decode"], vals["h2d"],
+                               vals["compute"], vals.get("measured"))
+
+
+# ----------------------------------------------------------------------
 # machine-readable byte budget (the CI regression gate)
 def byte_budget_entry(result):
     """The budget record for one captured breakdown."""
@@ -619,7 +683,16 @@ def main(argv=None):
                          "(ratchet after an intentional change)")
     ap.add_argument("--artifact-dir", default=None,
                     help="drop the layer-attributed breakdown JSON here")
+    ap.add_argument("--overlap", default=None, metavar="SPEC",
+                    help="attribute input-pipeline overlap from stage "
+                         "seconds, e.g. decode=0.26,h2d=0.71,"
+                         "compute=0.09,measured=0.77 (bench.py computes "
+                         "the same fields live as stream_*)")
     args = ap.parse_args(argv)
+
+    if args.overlap:
+        print(json.dumps(_parse_overlap_arg(args.overlap)))
+        return 0
 
     if args.check:
         return run_check(artifact_dir=args.artifact_dir,
