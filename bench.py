@@ -319,6 +319,67 @@ def _stream_pipeline(mx, mod, metric, staged_img_s, steps=None,
     return out
 
 
+def _zero_ab(mx, n_steps=4):
+    """ZeRO-1 / grad-dtype A/B on a small MLP over ALL local devices
+    (docs/how_to/perf.md "Optimizer sharding"): per-chip optimizer-state
+    bytes and the analytic per-chip gradient wire bytes for each
+    (zero, grad_dtype) corner, plus the max param divergence from the
+    replicated-f32 corner after ``n_steps`` identical steps.  Expected
+    shape of the result: state bytes ~1/n under zero=1, wire bytes
+    exactly halved under bf16, divergence 0.0 for zero (same math, same
+    bits) and ~1e-4 for bf16 (two bf16 roundings per grad element)."""
+    import jax
+    import numpy as np
+    from mxnet_tpu import parallel
+
+    devices = jax.devices()
+    if len(devices) < 2:
+        return {"skipped": "single-device host (A/B needs a >=2-way "
+                           "data mesh)"}
+    mesh = parallel.make_mesh({"data": len(devices)}, devices)
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=512, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=16, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    batch = 16 * len(devices)
+    rng = np.random.RandomState(0)
+    x = rng.randn(batch, 64).astype("f")
+    y = rng.randint(0, 16, (batch,)).astype("f")
+    w_init = None
+    rows, base = [], None
+    for zero, gdtype in ((0, "f32"), (1, "f32"), (0, "bf16"),
+                         (1, "bf16")):
+        t = parallel.Trainer(
+            sym, mx.optimizer.create("sgd", learning_rate=0.1,
+                                     momentum=0.9,
+                                     rescale_grad=1.0 / batch),
+            mesh=mesh, zero=zero, grad_dtype=gdtype)
+        t.bind(data_shapes={"data": (batch, 64)},
+               label_shapes={"softmax_label": (batch,)})
+        if w_init is None:
+            mx.random.seed(7)
+            t.init_params(mx.init.Xavier())
+            w_init = {n: v.asnumpy() for n, v in t.get_params()[0].items()}
+        else:
+            t.init_params(arg_params={n: mx.nd.array(v)
+                                      for n, v in w_init.items()})
+        for _ in range(n_steps):
+            t.step({"data": x, "softmax_label": y})
+        params = {n: np.asarray(v) for n, v in t.params.items()}
+        row = {"zero": zero, "grad_dtype": gdtype,
+               "opt_state_bytes_per_chip": t.opt_state_bytes_per_chip(),
+               "grad_comm_gb_per_step": round(
+                   t.grad_comm_bytes_per_step() / 1e9, 6)}
+        if base is None:
+            base = params
+        else:
+            row["max_param_diff_vs_f32_replicated"] = float(
+                max(np.abs(base[n] - params[n]).max() for n in base))
+        rows.append(row)
+    return {"n_devices": len(devices), "steps": n_steps, "rows": rows}
+
+
 def main():
     # fuse the Module step on every backend (the default for tpu contexts)
     os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
@@ -503,6 +564,24 @@ def main():
     elif mod._trainer.sentinel != "off":
         # sentinel armed process-wide: report the run's own skip count
         line["sentinel_skips"] = mod._trainer.sentinel_skips
+
+    # --- optimizer sharding / gradient comm accounting
+    # (docs/how_to/perf.md "Optimizer sharding"): the main module's
+    # per-chip state bytes + analytic gradient wire bytes, and the
+    # zero on/off x grad-dtype A/B on a data mesh over the local
+    # devices.  MXTPU_BENCH_ZERO_AB=0 skips the A/B compiles.
+    line["zero"] = mod._trainer.zero
+    line["grad_accum"] = mod._trainer.grad_accum
+    line["grad_dtype"] = mod._trainer.grad_dtype
+    line["opt_state_bytes_per_chip"] = \
+        mod._trainer.opt_state_bytes_per_chip()
+    line["grad_comm_gb_per_step"] = round(
+        mod._trainer.grad_comm_bytes_per_step() / 1e9, 6)
+    if os.environ.get("MXTPU_BENCH_ZERO_AB", "1") != "0":
+        try:
+            line["zero_ab"] = _zero_ab(mx)
+        except Exception as e:                      # noqa: BLE001
+            line["zero_ab_error"] = str(e)
 
     # --- streaming pipeline (datasets beyond HBM), wire-paced
     if on_tpu and os.environ.get("MXTPU_BENCH_STREAM_PROBE", "1") != "0":

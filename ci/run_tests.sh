@@ -144,13 +144,22 @@ stage "fault-injection suite (sentinel / crash-resume / io recovery)"
 # FAST tier by design (docs/how_to/resilience.md)
 python -m pytest tests/test_resilience.py -q
 
+stage "zero-1 / grad-accum / bf16-grad-comm suite (2-device CPU mesh)"
+# ZeRO-1 state sharding, microbatch accumulation, and reduced-precision
+# gradient comm: bitwise parity on exact arithmetic, resume parity under
+# mesh+zero1, the zero-opt-state lint pass — docs/how_to/perf.md
+# "Optimizer sharding"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_zero_accum.py -q
+
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_resilience.py and test_stream_pipeline.py already ran as their
-# own stages above
+# test_resilience.py, test_stream_pipeline.py and test_zero_accum.py
+# already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_resilience.py \
     --ignore=tests/test_stream_pipeline.py \
+    --ignore=tests/test_zero_accum.py \
     ${PYTEST_MARK[@]+"${PYTEST_MARK[@]}"}
 
 stage "distributed (2-worker local launcher)"

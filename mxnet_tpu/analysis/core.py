@@ -390,6 +390,7 @@ class PassContext:
     jaxpr: Any = None                      # ClosedJaxpr
     donated_invars: Optional[tuple] = None
     invar_labels: Optional[List[str]] = None   # pytree path per invar
+    invar_shardings: Optional[List[Any]] = None  # device sharding per invar
     platform: Optional[str] = None
     dtype_policy: Optional[str] = None
     is_train: bool = True

@@ -20,7 +20,8 @@ from .core import (ERROR, INFO, WARN, Finding, GraphPass, PassContext,
                    register_pass)
 
 __all__ = ["iter_eqns", "layer_of_eqn", "F64WideningPass",
-           "HostCallbackPass", "DonationPass", "GatherScatterPass"]
+           "HostCallbackPass", "DonationPass", "GatherScatterPass",
+           "ReplicatedOptStatePass"]
 
 _SCOPE_RE = re.compile(r"^(transpose\()?(?:jvp\()?([A-Za-z0-9_.\-]+?)\)*$")
 
@@ -233,6 +234,72 @@ class DonationPass(GraphPass):
                              for l, b in offenders[:5])),
                 detail={"offenders": [l for l, _ in offenders]}))
         return out
+
+
+@register_pass
+class ReplicatedOptStatePass(GraphPass):
+    """Replicated optimizer-state buffers on a data mesh with ZeRO off.
+
+    On a data-parallel mesh every chip holds a FULL copy of momentum /
+    variance unless ``Trainer(zero=1)`` shards them along the ``data``
+    axis (the reference kvstore's server-side state ownership) — pure
+    waste: the update for a slice only ever reads that slice's state.
+    Flags every ≥1 MB ``opt_state`` invar whose committed sharding does
+    not mention the ``data`` axis when one of size >1 exists and zero is
+    off, labelled by the same pytree path the donation pass uses.  Warn:
+    a small model (or a deliberate A/B) may not care; the baseline entry
+    keeps CI honest about when it appears.  Runs only on the
+    ``lint_trainer`` path — it needs live shardings and mesh metadata.
+    """
+
+    name = "zero-opt-state"
+    level = "jaxpr"
+
+    def run(self, ctx: PassContext):
+        if ctx.jaxpr is None or ctx.invar_labels is None \
+                or ctx.invar_shardings is None:
+            return []
+        n = int(ctx.config.get("data_axis_size", 1) or 1)
+        if n <= 1 or int(ctx.config.get("zero", 0) or 0):
+            return []
+        min_bytes = int(ctx.config.get("opt_state_min_bytes", 1 << 20))
+        jx = getattr(ctx.jaxpr, "jaxpr", ctx.jaxpr)
+        offenders, total = [], 0
+        for var, label, sh in zip(jx.invars, ctx.invar_labels,
+                                  ctx.invar_shardings):
+            if not label.startswith("opt_state"):
+                continue
+            aval = getattr(var, "aval", None)
+            if aval is None or not hasattr(aval, "dtype"):
+                continue
+            try:
+                itemsize = np.dtype(aval.dtype).itemsize
+            except TypeError:       # extended dtypes (PRNG keys)
+                continue
+            nbytes = int(np.prod(aval.shape or (1,)) * itemsize)
+            if nbytes < min_bytes:
+                continue
+            spec = getattr(sh, "spec", None)
+            axes = [a for e in (spec or ()) if e is not None
+                    for a in (e if isinstance(e, tuple) else (e,))]
+            if "data" in axes:
+                continue
+            offenders.append((label, nbytes))
+            total += nbytes
+        if not offenders:
+            return []
+        offenders.sort(key=lambda kv: -kv[1])
+        return [Finding(
+            self.name, WARN, "<step>", "pjit",
+            "%d optimizer-state buffer(s) totalling %.1f MB are "
+            "replicated across the %d-way data axis (every chip a full "
+            "copy; per-chip HBM could be ~1/%d): %s — enable "
+            "Trainer(zero=1) / MXTPU_ZERO=1"
+            % (len(offenders), total / 1e6, n, n,
+               ", ".join("%s (%.1f MB)" % (l, b / 1e6)
+                         for l, b in offenders[:5])),
+            detail={"offenders": [l for l, _ in offenders],
+                    "data_axis_size": n})]
 
 
 @register_pass

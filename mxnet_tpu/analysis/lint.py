@@ -206,7 +206,7 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
         report.extend([Finding("trace-failed", ERROR, "<step>", "<step>",
                                "tracing the fused step failed: %s" % e)])
         return report
-    jaxpr, donated, labels = closed, None, None
+    jaxpr, donated, labels, shardings = closed, None, None, None
     eqns = closed.jaxpr.eqns
     if len(eqns) == 1 and eqns[0].primitive.name == "pjit":
         jaxpr = eqns[0].params["jaxpr"]
@@ -217,14 +217,28 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
                             else "arg%d" % (p[0].idx if p else 0),
                             jax.tree_util.keystr(p[1:]))
                   for p, _ in leaves]
+        # live device shardings for the persistent-state invars (the
+        # batch/lr/t/key tail has no committed layout: None) — the
+        # zero-opt-state pass reads these to spot replicated state on a
+        # data mesh
+        state_args = (trainer.params, trainer.aux, trainer.opt_state) + \
+            (() if sent is None else (sent,))
+        state_shards = [getattr(v, "sharding", None)
+                        for v in jax.tree_util.tree_leaves(state_args)]
+        shardings = state_shards + [None] * (len(labels)
+                                             - len(state_shards))
         inner_n = len(getattr(jaxpr, "jaxpr", jaxpr).invars)
         if donated is not None and (len(donated) != inner_n
                                     or len(labels) != inner_n):
-            donated, labels = None, None   # layout surprise: skip donation
+            donated, labels, shardings = None, None, None  # layout surprise
+    lint_cfg = dict(config or {})
+    lint_cfg.setdefault("data_axis_size", trainer._data_axis_size())
+    lint_cfg.setdefault("zero", trainer.zero)
     ctx = PassContext(jaxpr=jaxpr, donated_invars=donated,
-                      invar_labels=labels, platform=trainer.prog.platform,
+                      invar_labels=labels, invar_shardings=shardings,
+                      platform=trainer.prog.platform,
                       dtype_policy=trainer.dtype_policy, is_train=True,
-                      config=config or {})
+                      config=lint_cfg)
     report.extend(run_passes(ctx, "jaxpr", only))
     report.traced = True
     return report

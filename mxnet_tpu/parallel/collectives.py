@@ -16,10 +16,10 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .mesh import get_mesh
+from .mesh import get_mesh, shard_map as _shard_map
 
 __all__ = ["global_allreduce", "barrier", "psum_over_mesh",
-           "broadcast_from_rank0"]
+           "broadcast_from_rank0", "lowp_allreduce", "lowp_comm_bytes"]
 
 
 def _process_count():
@@ -63,7 +63,7 @@ def global_allreduce(value):
         return jax.lax.psum(x, axis_name="data")
 
     f = jax.jit(
-        jax.shard_map(_sum, mesh=mesh,
+        _shard_map(_sum, mesh=mesh,
                       in_specs=PartitionSpec(*(["data"] + [None] * (value.ndim - 1))),
                       out_specs=PartitionSpec(*([None] * value.ndim))))
     # value is host-local; make it a global sharded array first
@@ -78,6 +78,64 @@ def global_allreduce(value):
 def psum_over_mesh(x, axis_name="data"):
     """In-step psum — call inside a shard_map'd/pjit'd computation."""
     return jax.lax.psum(x, axis_name=axis_name)
+
+
+def lowp_allreduce(x, axis_name, n, comm_dtype, keep_shard=False):
+    """Cross-replica gradient sum with a reduced-precision WIRE and an
+    f32 ACCUMULATOR — call inside a ``shard_map`` over ``axis_name``.
+
+    A plain ``psum`` on a bf16 operand would also accumulate in bf16
+    (XLA all-reduce computes in the operand dtype); here the reduction
+    is opened into its two phases so only the wire runs low-precision:
+
+    1. reduce-scatter: round local grads to ``comm_dtype``, ``all_to_all``
+       dim-0 chunks so replica *i* holds every replica's chunk *i*, then
+       sum the ``n`` contributions in f32 — each replica now owns the
+       exactly-f32-accumulated sum of its 1/n slice.
+    2. all-gather: round the reduced slice back to ``comm_dtype`` and
+       gather — unless ``keep_shard`` (the ZeRO-1 path), where the
+       owned f32 slice feeds the sharded optimizer update directly and
+       the gather (and its extra rounding) never happens.
+
+    Per-replica wire bytes: ``(n-1)/n * |g|`` at bf16 for the full
+    round trip vs ``2*(n-1)/n * |g|`` at f32 for a ring all-reduce —
+    exactly half, at any ``n``.  A leaf whose dim 0 does not divide by
+    ``n`` (small biases) falls back to all-gather + local f32 sum (same
+    result, wire ``(n-1) * |g|/2``; such leaves are KBs).
+
+    Rounding error: each element is rounded to bf16 at most twice
+    (before the wire, after the f32 accumulation), so the summed grad
+    carries <= 2 half-ulp bf16 roundings ~ 2^-8 relative — the
+    documented tolerance in docs/how_to/perf.md ("Optimizer sharding").
+    """
+    g16 = x.astype(comm_dtype)
+    d0 = x.shape[0] if x.ndim else 0
+    if x.ndim and d0 >= n and d0 % n == 0:
+        chunks = jax.lax.all_to_all(g16, axis_name, split_axis=0,
+                                    concat_axis=0, tiled=True)
+        summed = chunks.reshape((n, d0 // n) + x.shape[1:]) \
+                       .astype(jnp.float32).sum(axis=0)
+        if keep_shard:
+            return summed
+        return jax.lax.all_gather(summed.astype(comm_dtype), axis_name,
+                                  axis=0, tiled=True).astype(jnp.float32)
+    parts = jax.lax.all_gather(g16, axis_name)
+    out = parts.astype(jnp.float32).sum(axis=0)
+    if keep_shard:
+        return out      # not dim-0-divisible: the "shard" is the whole leaf
+    return out
+
+
+def lowp_comm_bytes(shape, n, comm_itemsize=2, keep_shard=False):
+    """Per-replica wire bytes :func:`lowp_allreduce` moves for one leaf
+    (the analytic model bench.py reports as ``grad_comm_gb_per_step``)."""
+    size = int(np.prod(shape or (1,)))
+    d0 = shape[0] if shape else 0
+    if d0 >= n and d0 % n == 0:
+        rs = (n - 1) / n * size * comm_itemsize
+        ag = 0 if keep_shard else (n - 1) / n * size * comm_itemsize
+        return rs + ag
+    return (n - 1) * size * comm_itemsize
 
 
 def barrier():

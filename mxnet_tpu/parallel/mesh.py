@@ -20,7 +20,37 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "get_mesh",
            "make_mesh", "current_mesh", "data_parallel_mesh",
-           "batch_sharding", "replicated"]
+           "batch_sharding", "replicated", "zero_spec", "shard_map"]
+
+
+def _resolve_shard_map():
+    """``jax.shard_map`` moved (experimental -> top level) and renamed
+    its replication-check kwarg (``check_rep`` -> ``check_vma``) across
+    jax releases; resolve whichever this jax exposes once, here, and
+    translate the kwarg, so every manual-sharding caller (ring
+    attention, pipeline, the bf16 grad-comm backward, global_allreduce)
+    survives both moves."""
+    import inspect
+    sm = getattr(jax, "shard_map", None)
+    if not callable(sm):
+        from jax.experimental.shard_map import shard_map as sm  # noqa: F811
+    try:
+        accepted = set(inspect.signature(sm).parameters)
+    except (TypeError, ValueError):      # pragma: no cover - exotic wrapper
+        return sm
+
+    def compat(*args, **kwargs):
+        for ours, theirs in (("check_vma", "check_rep"),
+                             ("check_rep", "check_vma")):
+            if ours in kwargs and ours not in accepted \
+                    and theirs in accepted:
+                kwargs[theirs] = kwargs.pop(ours)
+        return sm(*args, **kwargs)
+
+    return compat
+
+
+shard_map = _resolve_shard_map()
 
 _LOCAL = threading.local()
 
@@ -95,3 +125,28 @@ def batch_sharding(mesh: Mesh, ndim: int, axis: str = "data") -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec())
+
+
+def zero_spec(base_spec: PartitionSpec, shape: Sequence[int], n: int,
+              axis: str = "data") -> PartitionSpec:
+    """ZeRO sharding of a per-weight state leaf: ``base_spec`` (the
+    weight's own partitioning) with ``axis`` folded into the first
+    unsharded dim whose size divides by ``n`` — the TPU-mesh analog of
+    the reference kvstore's per-server key slices (each server owns a
+    contiguous slice of every value and updates only that slice).
+
+    A leaf with no divisible free dim (small biases, scalars) keeps
+    ``base_spec`` — replicating a few KB costs less than padded
+    collectives.  A ``base_spec`` that already names ``axis`` is
+    returned unchanged (the caller sharded it; nothing left to fold).
+    """
+    entries = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    used = [a for e in entries if e is not None
+            for a in (e if isinstance(e, tuple) else (e,))]
+    if axis in used:
+        return PartitionSpec(*entries)
+    for d, (e, dim) in enumerate(zip(entries, shape)):
+        if e is None and dim >= n and dim % n == 0:
+            entries[d] = axis
+            break
+    return PartitionSpec(*entries)

@@ -14,7 +14,7 @@ default to wd 0, matching ``Optimizer.set_wd_mult``).
 """
 from __future__ import annotations
 
-from typing import Callable, List, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..base import MXNetError
 from .. import optimizer as _opt
@@ -54,3 +54,54 @@ def make_update_fn(optimizer: "_opt.Optimizer", param_names: List[str]
         return new_params, new_state
 
     return init_fn, update_fn
+
+
+def state_shapes(optimizer: "_opt.Optimizer", param_names: List[str],
+                 param_shapes: Dict[str, tuple]):
+    """Abstract-eval the fused ``init_fn``: the optimizer-state pytree as
+    ``{name: tree of ShapeDtypeStruct}`` — no device allocation, so the
+    trainer can plan state shardings (and the linter can label state
+    buffers) before a single byte of state exists."""
+    import jax
+    import jax.numpy as jnp
+    init_fn, _ = make_update_fn(optimizer, param_names)
+    sds = {n: jax.ShapeDtypeStruct(tuple(param_shapes[n]), jnp.float32)
+           for n in param_names}
+    return jax.eval_shape(init_fn, sds)
+
+
+def zero_state_shardings(mesh, optimizer: "_opt.Optimizer",
+                         param_names: List[str],
+                         param_shapes: Dict[str, tuple],
+                         param_specs: Optional[Dict] = None,
+                         zero: int = 0, axis: str = "data"):
+    """Per-leaf :class:`NamedSharding` tree for the fused optimizer
+    state — the TPU-native form of the reference kvstore's server-side
+    state ownership (each server holds the momentum only for its key
+    slice, ``kvstore_dist_server.h``).
+
+    ``zero=0`` mirrors each weight's own sharding onto its state leaves
+    (replicated state on a data mesh — every chip a full copy).
+    ``zero=1`` folds the ``axis`` mesh axis into every leaf via
+    :func:`mesh.zero_spec`, so per-chip state bytes scale ~1/n along
+    that axis; leaves shaped unlike their weight fold on their own
+    shape, and leaves with no divisible dim stay on the weight spec.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec
+    import jax
+    from .mesh import zero_spec
+    param_specs = param_specs or {}
+    n = int(dict(mesh.shape).get(axis, 1))
+    shapes = state_shapes(optimizer, param_names, param_shapes)
+
+    def leaf_sharding(name, leaf):
+        base = param_specs.get(name, PartitionSpec())
+        if tuple(leaf.shape) != tuple(param_shapes[name]):
+            base = PartitionSpec()          # state leaf unlike its weight
+        if not zero or n <= 1:
+            return NamedSharding(mesh, base)
+        return NamedSharding(mesh, zero_spec(base, leaf.shape, n, axis))
+
+    return {name: jax.tree.map(lambda s, _n=name: leaf_sharding(_n, s),
+                               shapes[name])
+            for name in param_names}

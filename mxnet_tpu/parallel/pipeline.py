@@ -21,6 +21,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from .mesh import shard_map as _shard_map
 from jax.sharding import PartitionSpec
 
 __all__ = ["pipeline_apply"]
@@ -90,7 +92,7 @@ def pipeline_apply(stage_fn, stage_params, inputs, mesh, axis="pipe"):
         outs = jnp.where(stage == S - 1, outs, jnp.zeros_like(outs))
         return lax.psum(outs, axis)
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         per_device, mesh=mesh,
         in_specs=(param_spec, PartitionSpec()),
         out_specs=PartitionSpec(),

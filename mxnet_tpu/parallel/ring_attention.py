@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from .mesh import shard_map as _shard_map
+
 __all__ = ["ring_attention", "ring_attention_sharded", "attention_reference"]
 
 
@@ -78,7 +80,7 @@ def ring_attention_sharded(q, k, v, mesh, axis="seq", causal=False,
     """Apply ring attention to globally-shaped ``[b, t, h, d]`` arrays
     sharded (or shardable) over ``mesh[axis]`` on the time dimension."""
     spec = PartitionSpec(None, axis, None, None)
-    fn = jax.shard_map(
+    fn = _shard_map(
         partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

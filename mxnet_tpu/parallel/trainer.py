@@ -130,7 +130,10 @@ class Trainer:
                  loss_scale=None,
                  sentinel_max_skips: Optional[int] = None,
                  ls_growth_interval: Optional[int] = None,
-                 donate_batch: Optional[bool] = None):
+                 donate_batch: Optional[bool] = None,
+                 zero: Optional[int] = None,
+                 grad_accum: Optional[int] = None,
+                 grad_dtype: Optional[str] = None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -220,6 +223,52 @@ class Trainer:
                                            "0") in ("1", "true", "yes")
         self.donate_batch = bool(donate_batch)
         self.param_specs = param_specs or {}
+        # --- ZeRO-1 / gradient accumulation / reduced-precision grad
+        # comm (docs/how_to/perf.md "Optimizer sharding").  The
+        # reference's distributed kvstore ran the optimizer ON the
+        # servers, each owning a slice of the keys — optimizer state was
+        # naturally sharded across the cluster.  zero=1 recovers that on
+        # the mesh: every state leaf shards along the ``data`` axis, the
+        # update runs on the owned shard, updated params all-gather back.
+        def _as_int(value, what):
+            try:
+                return int(value)
+            except (TypeError, ValueError):
+                raise MXNetError("%s=%r is not an integer" % (what, value)) \
+                    from None
+
+        if zero is None:
+            zero = _os.environ.get("MXTPU_ZERO", "0")
+        self.zero = _as_int(zero, "zero (MXTPU_ZERO)")
+        if self.zero not in (0, 1):
+            raise MXNetError("zero=%r: supported stages are 0 (replicated "
+                             "optimizer state) and 1 (state sharded along "
+                             "the data axis)" % (zero,))
+        if grad_accum is None:
+            grad_accum = _os.environ.get("MXTPU_GRAD_ACCUM", "1")
+        self.grad_accum = _as_int(grad_accum, "grad_accum (MXTPU_GRAD_ACCUM)")
+        if self.grad_accum < 1:
+            raise MXNetError("grad_accum=%r: need a microbatch count >= 1"
+                             % (grad_accum,))
+        if grad_dtype is None:
+            grad_dtype = _os.environ.get("MXTPU_GRAD_DTYPE", "") or "f32"
+        _GD = {"f32": "f32", "float32": "f32",
+               "bf16": "bf16", "bfloat16": "bf16"}
+        if grad_dtype not in _GD:
+            raise MXNetError("grad_dtype=%r: bf16 or f32 (the cross-chip "
+                             "gradient wire dtype)" % (grad_dtype,))
+        self.grad_dtype = _GD[grad_dtype]
+        ndata = self._data_axis_size()
+        self._zero_on = self.zero == 1 and ndata > 1
+        self._lowp_on = self.grad_dtype == "bf16" and ndata > 1
+        if self._lowp_on and any(any(e is not None for e in tuple(s))
+                                 for s in self.param_specs.values()):
+            raise MXNetError(
+                "grad_dtype=bf16 runs the backward shard_map'd over the "
+                "data axis and does not compose with tensor-parallel "
+                "param_specs yet; keep f32 grad comm for sharded params")
+        self._opt_shardings = None     # per-leaf state shardings (mesh)
+        self._grad_shardings = None    # zero-sharded grad specs
         input_set = set(self.data_names) | set(self.label_names)
         self.param_names = [n for n in self.prog.arg_names
                             if n not in input_set]
@@ -234,6 +283,12 @@ class Trainer:
         self._lr_cache = None
         self._key = jax.random.key(0)
 
+    def _data_axis_size(self) -> int:
+        """Mesh ``data`` axis degree (1 without a mesh or data axis)."""
+        if self.mesh is None:
+            return 1
+        return int(dict(self.mesh.shape).get("data", 1))
+
     # ------------------------------------------------------------------
     def bind(self, data_shapes: Dict[str, tuple],
              label_shapes: Optional[Dict[str, tuple]] = None):
@@ -245,13 +300,43 @@ class Trainer:
             scale = jax.process_count()
             shapes = {n: (s[0] * scale,) + tuple(s[1:])
                       for n, s in shapes.items()}
-        arg_shapes, _, aux_shapes = self.symbol.infer_shape(**shapes)
+        arg_shapes, out_shapes, aux_shapes = self.symbol.infer_shape(**shapes)
         if arg_shapes is None:
             raise MXNetError("cannot infer shapes from %s" % shapes)
+        if (self.grad_accum > 1 or self._lowp_on) and out_shapes:
+            # both paths reassemble outputs along dim 0 (scan-stacked
+            # microbatches / shard_map out_specs): a REDUCED head
+            # (softmax_cross_entropy's (1,) loss, a scalar MakeLoss sum)
+            # would be silently stitched into per-microbatch/per-shard
+            # pieces instead of the big-batch value — refuse loudly
+            bsz = shapes[self.data_names[0]][0] \
+                if self.data_names and self.data_names[0] in shapes \
+                else next(iter(shapes.values()))[0]
+            for oname, oshape in zip(self.symbol.list_outputs(),
+                                     out_shapes or []):
+                if not oshape or oshape[0] != bsz:
+                    raise MXNetError(
+                        "grad_accum>1 / grad_dtype=bf16 need batch-major "
+                        "graph outputs, but %r has shape %s (batch %d): "
+                        "reduced-output heads are not supported on these "
+                        "paths" % (oname, tuple(oshape or ()), bsz))
         self._arg_shapes = dict(zip(self.prog.arg_names, arg_shapes))
         self._aux_shapes = dict(zip(self.aux_names, aux_shapes))
         self._input_shapes = {n: self._arg_shapes[n]
                               for n in self.data_names + self.label_names}
+        if self.grad_accum > 1:
+            ndata = self._data_axis_size()
+            for n, s in self._input_shapes.items():
+                if s[0] % self.grad_accum:
+                    raise MXNetError(
+                        "grad_accum=%d does not divide the %r batch dim %d"
+                        % (self.grad_accum, n, s[0]))
+                if ndata > 1 and (s[0] // self.grad_accum) % ndata:
+                    raise MXNetError(
+                        "microbatch %d (batch %d / grad_accum %d) is not "
+                        "divisible by the data-axis size %d"
+                        % (s[0] // self.grad_accum, s[0], self.grad_accum,
+                           ndata))
         self._build()
         return self
 
@@ -296,7 +381,17 @@ class Trainer:
         self.params, self.aux = params, aux
         init_fn, self._update_fn = make_update_fn(
             self.optimizer, self.param_names)
-        self.opt_state = jax.jit(init_fn)(params)
+        if self._opt_shardings is not None:
+            # state is born on its PLANNED sharding (zeros are not
+            # sharding-connected to the weights, so propagation alone
+            # could commit them anywhere).  Under zero=1 that means born
+            # SHARDED: each chip materializes only its owned slice —
+            # peak HBM never holds the replicated copy a post-hoc
+            # reshard would
+            self.opt_state = jax.jit(
+                init_fn, out_shardings=self._opt_shardings)(params)
+        else:
+            self.opt_state = jax.jit(init_fn)(params)
         if self.sentinel != "off" and self._sent is None:
             # created once per trainer, NOT per (re-)init: init_params
             # doesn't reset num_update, and Module.fit's epoch-end
@@ -319,6 +414,55 @@ class Trainer:
         return {"skips": jnp.int32(skips), "consec": jnp.int32(0),
                 "good": jnp.int32(0), "t": jnp.int32(t),
                 "scale": jnp.float32(scale)}
+
+    def _zero_keeps_shard(self, name: str) -> bool:
+        """True when ``name``'s zero-sharded grad spec owns dim 0 along
+        the data axis — the lowp reduce-scatter can then hand the update
+        its f32 shard directly (no gather, no extra bf16 rounding)."""
+        sh = (self._grad_shardings or {}).get(name)
+        return bool(sh is not None and len(sh.spec)
+                    and sh.spec[0] == "data")
+
+    def opt_state_bytes_per_chip(self) -> int:
+        """Optimizer-state bytes resident on ONE chip.  Replicated state
+        counts at full size (every chip holds a copy); zero-sharded
+        state at ~1/n — the number bench.py reports as
+        ``opt_state_bytes_per_chip``."""
+        if self.opt_state is None:
+            return 0
+        total, dev = 0, None
+        for leaf in jax.tree.leaves(self.opt_state):
+            shards = getattr(leaf, "addressable_shards", None)
+            if not shards:
+                total += int(getattr(leaf, "nbytes", 0))
+                continue
+            if dev is None:
+                dev = shards[0].device
+            total += sum(int(s.data.nbytes) for s in shards
+                         if s.device == dev)
+        return int(total)
+
+    def grad_comm_bytes_per_step(self) -> int:
+        """Analytic per-chip gradient-comm wire bytes for one fused step
+        (0 without a >1 data axis).  f32 SPMD path: ring all-reduce
+        ``2*(n-1)/n`` of the f32 grad bytes, once per microbatch (the
+        psum lives inside each scan iteration).  bf16 path: the two-phase
+        reduce in ``collectives.lowp_allreduce`` — half the f32 bytes —
+        fired once per step regardless of ``grad_accum``."""
+        n = self._data_axis_size()
+        if n <= 1:
+            return 0
+        from .collectives import lowp_comm_bytes
+        total = 0.0
+        for nm in self.param_names:
+            shape = tuple(self._arg_shapes[nm])
+            if self._lowp_on:
+                total += lowp_comm_bytes(
+                    shape, n, 2, keep_shard=self._zero_keeps_shard(nm))
+            else:
+                size = int(np.prod(shape or (1,)))
+                total += 2 * (n - 1) / n * size * 4 * self.grad_accum
+        return int(total)
 
     def _place(self, value, sharding):
         if sharding is None:
@@ -394,12 +538,41 @@ class Trainer:
         scaling = self.loss_scale is not None and self._ls_applies
         dynamic_ls = self.loss_scale == "dynamic"
         growth = self.ls_growth_interval
+        K = self.grad_accum
+        ndata = self._data_axis_size()
+        zero_on = self._zero_on
+        lowp_on = self._lowp_on
+        mesh = self.mesh
+        has_rng = prog.has_rng
 
-        def _backward(params, aux, batch, key, scale):
-            aux_vals = [aux[n] for n in aux_names]
+        # --- ZeRO-1 planning: per-leaf optimizer-state (and grad)
+        # shardings along the mesh ``data`` axis, computed from the
+        # abstract state pytree so init can place state ALREADY sharded
+        # (peak HBM never holds a replicated copy) and resume can place
+        # restored leaves back onto the owned shards.
+        self._opt_shardings = None
+        self._grad_shardings = None
+        if mesh is not None and mesh.size > 1:
+            from .optim import zero_state_shardings
+            from .mesh import zero_spec as _zero_spec
+            self._opt_shardings = zero_state_shardings(
+                mesh, self.optimizer, self.param_names, self._arg_shapes,
+                self.param_specs, zero=1 if zero_on else 0)
+            if zero_on:
+                self._grad_shardings = {
+                    n: NamedSharding(mesh, _zero_spec(
+                        self.param_specs.get(n, PartitionSpec()),
+                        self._arg_shapes[n], ndata))
+                    for n in self.param_names}
 
+        def _micro_backward(params, aux_vals, batch, key, scale):
+            """One microbatch fwd+vjp.  Returns ``(outs, new_aux tuple,
+            f32 grads)`` with the loss scale still folded into the grads
+            — unscaling happens once per STEP, after accumulation and
+            the cross-chip reduction, so every microbatch pays only the
+            seed multiply."""
             def fwd(p):
-                return _forward(p, aux_vals, batch, key, True)
+                return _forward(p, list(aux_vals), batch, key, True)
 
             if policy is not None:
                 fwd = jax.checkpoint(fwd, policy=policy)
@@ -410,8 +583,8 @@ class Trainer:
             # cotangent half; its reduction half (f32 accumulation)
             # lives in the op backward formulations (op/bytediet.py) and
             # in the f32 master-weight grad cast below.  The loss scale
-            # rides the seeds (and is divided back out of the f32
-            # grads): small bf16 cotangents stay out of flush-to-zero.
+            # rides the seeds: small bf16 cotangents stay out of
+            # flush-to-zero.
             if scale is None:
                 seeds = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
             else:
@@ -420,13 +593,7 @@ class Trainer:
             cot = (seeds,
                    tuple(jnp.zeros(a.shape, a.dtype) for a in new_aux))
             grads = vjp(cot)[0]
-            if scale is None:
-                grads = {n: g.astype(jnp.float32)
-                         for n, g in grads.items()}
-            else:
-                inv = 1.0 / scale
-                grads = {n: g.astype(jnp.float32) * inv
-                         for n, g in grads.items()}
+            grads = {n: g.astype(jnp.float32) for n, g in grads.items()}
             # aux (BN moving stats) keep fp32 master copies like params do
             new_aux = tuple(
                 v.astype(jnp.float32)
@@ -434,12 +601,154 @@ class Trainer:
                 for v in new_aux)
             return outs, new_aux, grads
 
-        def step(params, aux, opt_state, batch, lr, t, key):
-            outs, new_aux, grads = _backward(params, aux, batch, key, None)
+        def _accum_backward(params, aux_vals, batch, key, scale, spmd):
+            """K-microbatch gradient accumulation inside ONE jitted step
+            (``grad_accum``): reshape the batch to a leading microbatch
+            dim and ``lax.scan`` the vjp over it, summing into an f32
+            grad buffer; the optimizer update fires once per K.  On the
+            lowp (shard_map) path the cross-chip reduction also fires
+            once per K — the SPMD path's psum stays inside each scan
+            iteration because GSPMD cannot represent an unreduced
+            partial-sum carry (documented in perf.md)."""
+            if K == 1:
+                return _micro_backward(params, tuple(aux_vals), batch, key,
+                                       scale)
+            mb = {}
+            for nm, v in batch.items():
+                m = v.shape[0] // K
+                v = v.reshape((K, m) + v.shape[1:])
+                if spmd and self._batch_shardings is not None \
+                        and "data" in mesh.axis_names:
+                    # keep each MICROBATCH row-sharded over the data axis
+                    # (the reshape would otherwise tempt the partitioner
+                    # to shard the scan dim)
+                    v = jax.lax.with_sharding_constraint(
+                        v, NamedSharding(mesh,
+                                         PartitionSpec(None, "data")))
+                mb[nm] = v
+
+            def body(carry, xs):
+                aux_c, gsum = carry
+                batch_i, i = xs
+                k = jax.random.fold_in(key, i) if has_rng else key
+                outs, new_aux, g = _micro_backward(params, aux_c, batch_i,
+                                                   k, scale)
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (new_aux, gsum), outs
+
+            gsum0 = {nm: jnp.zeros(params[nm].shape, jnp.float32)
+                     for nm in params}
+            (aux_fin, gsum), outs_k = jax.lax.scan(
+                body, (tuple(aux_vals), gsum0), (mb, jnp.arange(K)))
+            # microbatch k produced rows [k*m, (k+1)*m): flattening the
+            # (K, m, ...) stack restores the original batch order
+            outs = tuple(o.reshape((o.shape[0] * o.shape[1],)
+                                   + o.shape[2:]) for o in outs_k)
+            return outs, aux_fin, gsum
+
+        if lowp_on:
+            from .mesh import shard_map
+            from .collectives import lowp_allreduce
+            keep_shard = {nm: self._zero_keeps_shard(nm)
+                          for nm in self.param_names}
+
+            def _lowp_backward(params, aux_vals, batch, key, scale):
+                """Reduced-precision gradient comm (``grad_dtype=bf16``):
+                the backward runs shard_map'd over the data axis so the
+                gradient reduction is EXPLICIT — local grads round to
+                bf16 before the wire and the reduction accumulates in
+                f32 (collectives.lowp_allreduce), halving cross-chip
+                gradient bytes.  Per-replica semantics shift with the
+                manual sharding: BN batch stats are computed per shard
+                and pmean-combined (the reference's multi-device BN),
+                and dropout decorrelates via a per-shard key fold."""
+                def local(params, aux_vals, batch, key, *maybe_scale):
+                    sc = maybe_scale[0] if maybe_scale else None
+                    if has_rng:
+                        key2 = jax.random.fold_in(
+                            key, jax.lax.axis_index("data"))
+                    else:
+                        key2 = key
+                    outs, new_aux, g = _accum_backward(
+                        params, aux_vals, batch, key2, sc, spmd=False)
+                    with jax.named_scope("grad_allreduce_bf16"):
+                        g = {nm: lowp_allreduce(gl, "data", ndata,
+                                                jnp.bfloat16,
+                                                keep_shard=keep_shard[nm])
+                             for nm, gl in g.items()}
+                    new_aux = tuple(
+                        jax.lax.pmean(v, "data")
+                        if jnp.issubdtype(v.dtype, jnp.floating) else v
+                        for v in new_aux)
+                    return outs, new_aux, g
+
+                P = PartitionSpec
+                gspecs = {nm: P("data") if keep_shard[nm] else P()
+                          for nm in self.param_names}
+                in_specs = (P(), P(), P("data"), P()) + (
+                    (P(),) if scale is not None else ())
+                args = (params, tuple(aux_vals), batch, key) + (
+                    (scale,) if scale is not None else ())
+                # check_rep can't statically see through the
+                # all_to_all/all_gather pair; replication of the P()
+                # outputs holds by construction (pmean'd aux, gathered
+                # grads)
+                return shard_map(local, mesh=mesh, in_specs=in_specs,
+                                 out_specs=(P("data"), P(), gspecs),
+                                 check_rep=False)(*args)
+
+        def _run_backward(params, aux, batch, key, scale):
+            """fwd+bwd (+accumulation, +grad comm) for one step: returns
+            ``(outs, new_aux tuple, f32 grads)`` with the loss scale
+            divided back out and, under zero=1, grads constrained onto
+            the owned shard (reduce-scatter instead of all-reduce — the
+            update only ever reads the shard)."""
+            aux_vals = [aux[n] for n in aux_names]
+            if lowp_on:
+                outs, new_aux, grads = _lowp_backward(params, aux_vals,
+                                                      batch, key, scale)
+            else:
+                outs, new_aux, grads = _accum_backward(params, aux_vals,
+                                                       batch, key, scale,
+                                                       spmd=True)
+            if scale is not None:
+                inv = 1.0 / scale
+                grads = {n: g * inv for n, g in grads.items()}
+            if zero_on:
+                with jax.named_scope("zero_grad_shard"):
+                    grads = {n: jax.lax.with_sharding_constraint(
+                        g, self._grad_shardings[n])
+                        for n, g in grads.items()}
+            return outs, new_aux, grads
+
+        p_shard_all = {n: self._param_sharding(n) for n in self.param_names}
+
+        def _apply_update(params, grads, opt_state, lr, t):
             # named scope: the breakdown tool attributes optimizer-state
             # traffic to this label instead of "(unattributed)"
             with jax.named_scope("optimizer_update"):
                 new_params, new_state = update_fn(params, grads, opt_state,
+                                                  lr, t)
+            if zero_on:
+                with jax.named_scope("zero_shard"):
+                    # state stays on the owned shard; updated params
+                    # all-gather back to their own (replicated or
+                    # tensor-parallel) sharding for the next forward
+                    new_state = {
+                        n: jax.tree.map(jax.lax.with_sharding_constraint,
+                                        new_state[n],
+                                        self._opt_shardings[n])
+                        for n in new_state}
+                    new_params = {
+                        n: jax.lax.with_sharding_constraint(
+                            v, p_shard_all[n])
+                        for n, v in new_params.items()}
+            return new_params, new_state
+
+        def step(params, aux, opt_state, batch, lr, t, key):
+            outs, new_aux, grads = _run_backward(params, aux, batch, key,
+                                                 None)
+            new_params, new_state = _apply_update(params, grads, opt_state,
                                                   lr, t)
             return (new_params, dict(zip(aux_names, new_aux)), new_state,
                     tuple(o.astype(jnp.float32) for o in outs))
@@ -458,16 +767,15 @@ class Trainer:
             step RNG key) still advances on a skip — GradScaler
             semantics, see docs/how_to/resilience.md."""
             scale = sent["scale"] if scaling else None
-            outs, new_aux, grads = _backward(params, aux, batch, key,
-                                             scale)
+            outs, new_aux, grads = _run_backward(params, aux, batch, key,
+                                                 scale)
             with jax.named_scope("sentinel_finite"):
                 finite = jnp.bool_(True)
                 for n in param_names_sorted:
                     finite = jnp.logical_and(
                         finite, jnp.all(jnp.isfinite(grads[n])))
             t_eff = sent["t"] + 1
-            with jax.named_scope("optimizer_update"):
-                new_params, new_state = update_fn(params, grads, opt_state,
+            new_params, new_state = _apply_update(params, grads, opt_state,
                                                   lr, t_eff)
             with jax.named_scope("sentinel_select"):
                 keep = lambda new, old: jnp.where(finite, new, old)  # noqa: E731
@@ -521,23 +829,38 @@ class Trainer:
             rep = replicated(mesh)
             p_shard = {n: self._param_sharding(n) for n in self.param_names}
             a_shard = {n: self._param_sharding(n) for n in self.aux_names}
-            # opt state mirrors param sharding per leaf; the sentinel
+            # opt state mirrors param sharding per leaf — except under
+            # zero=1, where the explicit zero-sharded specs are enforced
+            # at the boundary (in == out == owned shard: the donated
+            # update stays a true in-place shard write).  The sentinel
             # state is five replicated scalars (sharding left to the
-            # partitioner), donated with the rest of the carried state
+            # partitioner), donated with the rest of the carried state.
+            opt_in = self._opt_shardings
+            # OUTPUT shardings for the carried state are pinned to the
+            # same specs as the inputs: the partitioner is otherwise
+            # free to hand state back under a different layout (a
+            # model-sharded classifier tempts it to co-shard BN aux or
+            # conv-weight momentum, breaking the donation alias and the
+            # NEXT call's in_shardings; zero's constrained-but-unpinned
+            # params came back row-sharded).  in == out == planned spec
+            # keeps every donated state write a true in-place update.
+            # Sentinel scalars and the graph outputs stay unpinned.
+            zout = {"out_shardings": (p_shard, a_shard, opt_in) + (
+                (None,) if not sentinel_on else (None, None))}
             if sentinel_on:
                 self._step_fn = jax.jit(
                     step_sentinel,
-                    in_shardings=(p_shard, a_shard, None, None,
+                    in_shardings=(p_shard, a_shard, opt_in, None,
                                   self._batch_shardings, None, None, None),
                     donate_argnums=(0, 1, 2, 3) + (
-                        (4,) if self.donate_batch else ()))
+                        (4,) if self.donate_batch else ()), **zout)
             else:
                 self._step_fn = jax.jit(
                     step,
-                    in_shardings=(p_shard, a_shard, None,
+                    in_shardings=(p_shard, a_shard, opt_in,
                                   self._batch_shardings, None, None, None),
                     donate_argnums=(0, 1, 2) + (
-                        (3,) if self.donate_batch else ()))
+                        (3,) if self.donate_batch else ()), **zout)
             self._eval_fn = jax.jit(
                 evaluate,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
@@ -730,26 +1053,37 @@ class Trainer:
         cur = self.opt_state
 
         def _restore(sharding, c, n):
-            # restore onto the PARAM sharding (opt state mirrors it per
-            # leaf) — the current leaf's own sharding can be an
-            # uncommitted single-device placement from the jitted
-            # init_fn, and committing the restored copy there would trip
-            # the step's device-set consistency check on a mesh
+            # restore onto the PLANNED sharding — the zero-sharded spec
+            # under zero=1, else the param sharding (opt state mirrors
+            # it per leaf).  NOT the current leaf's own sharding: that
+            # can be an uncommitted single-device placement from the
+            # jitted init_fn, and committing the restored copy there
+            # would trip the step's device-set consistency check on a
+            # mesh.  The serialized blob always holds gathered-on-host
+            # GLOBAL leaves (``get_opt_states`` reads through
+            # ``_host_value``), so an old replicated blob restores onto
+            # a zero-sharded run — and vice versa — by construction.
             if sharding is None:
                 return jnp.asarray(n)
             if self.multihost:
-                # n is the GLOBAL array (what get_opt_states saved);
-                # hand each device exactly its slice of it
+                # hand each device exactly its slice of the global array
                 n = np.asarray(n)
                 return jax.make_array_from_callback(
                     n.shape, sharding, lambda idx: n[idx])
             return jax.device_put(jnp.asarray(n), sharding)
 
-        self.opt_state = {
-            name: jax.tree.map(
-                lambda c, n, _sh=self._param_sharding(name):
-                _restore(_sh, c, n), cur[name], state[name])
-            for name in cur}
+        if self._opt_shardings is not None:
+            # per-LEAF shardings (zero-sharded or param-mirrored)
+            self.opt_state = {
+                name: jax.tree.map(_restore, self._opt_shardings[name],
+                                   cur[name], state[name])
+                for name in cur}
+        else:
+            self.opt_state = {
+                name: jax.tree.map(
+                    lambda c, n, _sh=self._param_sharding(name):
+                    _restore(_sh, c, n), cur[name], state[name])
+                for name in cur}
 
     # ------------------------------------------------------------------
     def _host_value(self, v):

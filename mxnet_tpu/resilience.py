@@ -185,6 +185,16 @@ class CheckpointManager:
             else None,
             "sentinel_skips": trainer.sentinel_skips
             if trainer is not None else None,
+            # state-layout provenance: the opt-states blob always holds
+            # gathered-on-host GLOBAL leaves, so a blob saved replicated
+            # restores onto a zero-sharded run (and vice versa) — this
+            # records what produced it, for post-mortems and the
+            # restore-time layout note below
+            "trainer": None if trainer is None else {
+                "zero": trainer.zero,
+                "grad_accum": trainer.grad_accum,
+                "grad_dtype": trainer.grad_dtype,
+            },
             "rng": {"impl": "fold_in(key(0), num_update)"},
             "wallclock": time.time(),
             "files": files,
@@ -295,4 +305,13 @@ class CheckpointManager:
                                       False):
             self._retry(lambda: module.load_optimizer_states(
                 ck.states_path), "optimizer state read")
+            saved = (ck.manifest or {}).get("trainer") or {}
+            trainer = getattr(module, "_trainer", None)
+            if trainer is not None and saved \
+                    and saved.get("zero") != trainer.zero:
+                logging.getLogger("mxtpu.resilience").info(
+                    "optimizer state saved with zero=%s restored into a "
+                    "zero=%s run (fine: blobs hold gathered global "
+                    "leaves; placement follows the restoring trainer)",
+                    saved.get("zero"), trainer.zero)
         return ck

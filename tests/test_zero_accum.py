@@ -1,0 +1,353 @@
+"""ZeRO-1 sharded optimizer state, microbatch gradient accumulation, and
+reduced-precision gradient comm in the fused step (docs/how_to/perf.md
+"Optimizer sharding").
+
+Parity strategy: the *bitwise* assertions run on an exactly-representable
+regression net — integer data, dyadic-rational weights, power-of-two
+lr/momentum/rescale — where every product and partial sum is exact in
+f32, so ANY reduction/fusion order the partitioner picks must produce
+identical bits (a chunked dot is NOT bitwise-equal to a monolithic one
+on arbitrary floats; it is on exact ones).  Random-data runs then bound
+the float drift of the same comparisons.  Runs on the virtual 8-device
+CPU mesh (conftest) — the same code path as a TPU slice.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel
+from mxnet_tpu.base import MXNetError
+
+
+def _mesh2():
+    return parallel.make_mesh({"data": 2}, jax.devices()[:2])
+
+
+# ----------------------------------------------------------------------
+# the exactly-representable regression net
+def _exact_net():
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=8, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=2, name="fc2")
+    return mx.symbol.LinearRegressionOutput(net, name="lro")
+
+
+def _exact_data(seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randint(-2, 3, (16, 6)).astype("f")
+    y = rng.randint(-2, 3, (16, 2)).astype("f")
+    args = {"fc1_weight": (rng.randint(-4, 5, (8, 6)) / 8.0).astype("f"),
+            "fc1_bias": np.zeros(8, "f"),
+            "fc2_weight": (rng.randint(-4, 5, (2, 8)) / 8.0).astype("f"),
+            "fc2_bias": np.zeros(2, "f")}
+    return x, y, args
+
+
+def _run_exact(x, y, args, mesh, steps, collect_outs=False, **kw):
+    t = parallel.Trainer(
+        _exact_net(),
+        mx.optimizer.create("sgd", learning_rate=0.25, momentum=0.5,
+                            rescale_grad=1.0 / 16),
+        label_names=("lro_label",), mesh=mesh, **kw)
+    t.bind(data_shapes={"data": (16, 6)},
+           label_shapes={"lro_label": (16, 2)})
+    t.init_params(arg_params={k: mx.nd.array(v) for k, v in args.items()})
+    outs = None
+    for _ in range(steps):
+        outs = t.step({"data": x, "lro_label": y})
+    params = {n: np.asarray(v) for n, v in t.params.items()}
+    if collect_outs:
+        return t, params, outs[0].asnumpy()
+    return t, params
+
+
+def _assert_bitwise(a, b, what):
+    for n in a:
+        assert (a[n] == b[n]).all(), \
+            "%s: %s differs (max %g)" % (what, n, np.abs(a[n] - b[n]).max())
+
+
+# ----------------------------------------------------------------------
+# ZeRO-1
+def test_zero1_bit_parity_with_replicated():
+    """zero=1 changes WHERE the update math runs (the owned shard), not
+    the math: final params bitwise-equal to the replicated mesh path —
+    on exact data AND on random floats (elementwise update + an order-
+    free 2-way reduction)."""
+    mesh = _mesh2()
+    x, y, args = _exact_data()
+    _, rep = _run_exact(x, y, args, mesh, 5)
+    _, z = _run_exact(x, y, args, mesh, 5, zero=1)
+    _assert_bitwise(rep, z, "zero1 vs replicated (exact data)")
+
+    rng = np.random.RandomState(7)
+    xr = rng.randn(16, 6).astype("f")
+    yr = rng.randn(16, 2).astype("f")
+    _, rep = _run_exact(xr, yr, args, mesh, 5)
+    _, z = _run_exact(xr, yr, args, mesh, 5, zero=1)
+    _assert_bitwise(rep, z, "zero1 vs replicated (random data)")
+
+
+def test_zero1_shards_state_and_shrinks_per_chip_bytes():
+    mesh = _mesh2()
+    x, y, args = _exact_data()
+    t_rep, _ = _run_exact(x, y, args, mesh, 1)
+    t_z, _ = _run_exact(x, y, args, mesh, 1, zero=1)
+    # state born sharded along the data axis
+    for n, leaf in t_z.opt_state.items():
+        axes = [a for e in leaf.sharding.spec if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))]
+        assert "data" in axes, (n, leaf.sharding.spec)
+    rep_b = t_rep.opt_state_bytes_per_chip()
+    z_b = t_z.opt_state_bytes_per_chip()
+    assert rep_b > 0 and z_b * 2 == rep_b, (rep_b, z_b)
+    # params stay replicated for the forward
+    for n, leaf in t_z.params.items():
+        assert leaf.sharding.spec == jax.sharding.PartitionSpec()
+
+
+def test_zero1_single_device_is_inert():
+    x, y, args = _exact_data()
+    _, base = _run_exact(x, y, args, None, 2)
+    t, z = _run_exact(x, y, args, None, 2, zero=1)
+    assert not t._zero_on
+    _assert_bitwise(base, z, "zero1 without a mesh")
+
+
+# ----------------------------------------------------------------------
+# gradient accumulation
+def test_grad_accum_bit_identical_to_big_batch_exact():
+    """One K-microbatch step == one big-batch step, to the BIT, on the
+    exact net — single-device and 2-way mesh, with and without zero."""
+    x, y, args = _exact_data()
+    for mesh, kw in [(None, {}), (_mesh2(), {}), (_mesh2(), dict(zero=1))]:
+        _, base, o_base = _run_exact(x, y, args, mesh, 1,
+                                     collect_outs=True, **kw)
+        _, acc, o_acc = _run_exact(x, y, args, mesh, 1, collect_outs=True,
+                                   grad_accum=4, **kw)
+        _assert_bitwise(base, acc, "grad_accum=4 vs big batch (%s)" % (kw,))
+        # outputs reassemble in original batch-row order
+        assert (o_base == o_acc).all()
+
+
+def test_grad_accum_matches_big_batch_multi_step():
+    """Across steps the exactness horizon passes (denominators outgrow
+    the f32 mantissa) and chunked dots drift at the ulp level — bounded
+    here at 1e-6 over 6 steps on random floats."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(16, 6).astype("f")
+    y = rng.randn(16, 2).astype("f")
+    _, _, args = _exact_data()
+    for mesh in (None, _mesh2()):
+        _, base = _run_exact(x, y, args, mesh, 6)
+        _, acc = _run_exact(x, y, args, mesh, 6, grad_accum=4)
+        for n in base:
+            np.testing.assert_allclose(base[n], acc[n], atol=1e-6,
+                                       err_msg=n)
+
+
+def test_grad_accum_validation():
+    x, y, args = _exact_data()
+    t = parallel.Trainer(_exact_net(), mx.optimizer.create("sgd"),
+                         label_names=("lro_label",), grad_accum=5)
+    with pytest.raises(MXNetError, match="grad_accum=5 does not divide"):
+        t.bind(data_shapes={"data": (16, 6)},
+               label_shapes={"lro_label": (16, 2)})
+    with pytest.raises(MXNetError, match="microbatch"):
+        parallel.Trainer(_exact_net(), mx.optimizer.create("sgd"),
+                         label_names=("lro_label",), mesh=_mesh2(),
+                         grad_accum=16).bind(
+            data_shapes={"data": (16, 6)},
+            label_shapes={"lro_label": (16, 2)})
+    with pytest.raises(MXNetError, match="zero="):
+        parallel.Trainer(_exact_net(), mx.optimizer.create("sgd"), zero=2)
+    with pytest.raises(MXNetError, match="grad_dtype"):
+        parallel.Trainer(_exact_net(), mx.optimizer.create("sgd"),
+                         grad_dtype="fp8")
+    from jax.sharding import PartitionSpec
+    with pytest.raises(MXNetError, match="param_specs"):
+        parallel.Trainer(
+            _exact_net(), mx.optimizer.create("sgd"), mesh=_mesh2(),
+            grad_dtype="bf16",
+            param_specs={"fc1_weight": PartitionSpec("data", None)})
+    with pytest.raises(MXNetError, match="not an integer"):
+        parallel.Trainer(_exact_net(), mx.optimizer.create("sgd"),
+                         zero="true")
+    # reduced (non-batch-major) output heads: the scan/shard_map output
+    # reassembly cannot represent them — bind refuses loudly
+    red = mx.sym.softmax_cross_entropy(
+        mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=4,
+                              name="fcr"),
+        mx.sym.Variable("red_label"))
+    with pytest.raises(MXNetError, match="batch-major"):
+        parallel.Trainer(red, mx.optimizer.create("sgd"),
+                         label_names=("red_label",), grad_accum=2).bind(
+            data_shapes={"data": (16, 6)},
+            label_shapes={"red_label": (16,)})
+
+
+# ----------------------------------------------------------------------
+# reduced-precision gradient comm
+def test_bf16_grad_comm_tolerance_and_bytes():
+    """bf16 wire + f32 accumulation: each grad element suffers at most
+    two bf16 roundings (~2^-8 relative each), so one step's param delta
+    stays within 2^-6 of the f32-comm delta relative to its magnitude —
+    and the path genuinely differs (a zero diff would mean the rounding
+    never happened).  Reported wire bytes halve exactly."""
+    mesh = _mesh2()
+    rng = np.random.RandomState(11)
+    x = rng.randn(16, 6).astype("f")
+    y = rng.randn(16, 2).astype("f")
+    _, _, args = _exact_data()
+    t32, p32 = _run_exact(x, y, args, mesh, 1)
+    t16, p16 = _run_exact(x, y, args, mesh, 1, grad_dtype="bf16")
+    diff = max(float(np.abs(p32[n] - p16[n]).max()) for n in p32)
+    delta = max(float(np.abs(p32[n] - args[n]).max()) for n in p32)
+    assert 0 < diff <= delta * 2.0 ** -6, (diff, delta)
+    assert t16.grad_comm_bytes_per_step() * 2 == \
+        t32.grad_comm_bytes_per_step()
+
+
+def test_bf16_comm_composes_with_zero_and_accum():
+    mesh = _mesh2()
+    x, y, args = _exact_data()
+    t32, p32 = _run_exact(x, y, args, mesh, 3)
+    t, p = _run_exact(x, y, args, mesh, 3, zero=1, grad_accum=4,
+                      grad_dtype="bf16")
+    for n in p32:
+        np.testing.assert_allclose(p32[n], p[n], atol=5e-3, err_msg=n)
+    # zero keeps the reduce-scattered f32 shard: no gather half at all
+    assert t.grad_comm_bytes_per_step() * 4 == \
+        t32.grad_comm_bytes_per_step() * t32.grad_accum
+
+
+# ----------------------------------------------------------------------
+# sentinel composition
+def test_sentinel_skips_poisoned_microbatch_under_accum_zero():
+    mesh = _mesh2()
+    x, y, args = _exact_data()
+    t, _ = _run_exact(x, y, args, mesh, 2, zero=1, grad_accum=4,
+                      sentinel="skip")
+    before_p = {n: np.asarray(v) for n, v in t.params.items()}
+    before_s = [np.asarray(v) for v in jax.tree.leaves(t.opt_state)]
+    xb = x.copy()
+    xb[5] = np.nan          # poisons exactly one microbatch's grads
+    t.step({"data": xb, "lro_label": y})
+    assert t.sentinel_skips == 1
+    after_p = {n: np.asarray(v) for n, v in t.params.items()}
+    _assert_bitwise(before_p, after_p, "sentinel skip under zero+accum")
+    for a, b in zip(before_s, jax.tree.leaves(t.opt_state)):
+        assert (a == np.asarray(b)).all()
+    # a clean batch afterwards updates again
+    t.step({"data": x, "lro_label": y})
+    assert t.sentinel_skips == 1
+    moved = {n: np.asarray(v) for n, v in t.params.items()}
+    assert any((moved[n] != before_p[n]).any() for n in moved)
+
+
+# ----------------------------------------------------------------------
+# resume parity
+def test_resume_parity_under_mesh_zero1():
+    """Save (opt blob + params) mid-run under mesh+zero1, restore into a
+    FRESH trainer, continue: bitwise-identical to the uninterrupted run
+    — state round-trips host-gathered global leaves back onto the owned
+    shards."""
+    mesh = _mesh2()
+    x, y, args = _exact_data()
+    rng = np.random.RandomState(5)
+    xr = rng.randn(16, 6).astype("f")
+    yr = rng.randn(16, 2).astype("f")
+
+    t_ref, _ = _run_exact(xr, yr, args, mesh, 3, zero=1, sentinel="skip")
+    blob = t_ref.get_opt_states()
+    # snapshot to host NOW: get_params wraps the live (donated-next-step)
+    # buffers — the same read-then-persist order CheckpointManager uses
+    arg_p = {n: v.asnumpy() for n, v in t_ref.get_params()[0].items()}
+    aux_p = {}
+    for _ in range(3):
+        t_ref.step({"data": xr, "lro_label": yr})
+    ref = {n: np.asarray(v) for n, v in t_ref.params.items()}
+
+    t_res, _ = _run_exact(xr, yr, args, mesh, 1, zero=1, sentinel="skip")
+    t_res.set_opt_states(blob)
+    t_res.set_params(arg_p, aux_p)
+    for n, leaf in t_res.opt_state.items():
+        axes = [a for e in leaf.sharding.spec if e is not None
+                for a in (e if isinstance(e, tuple) else (e,))]
+        assert "data" in axes, (n, leaf.sharding.spec)
+    for _ in range(3):
+        t_res.step({"data": xr, "lro_label": yr})
+    res = {n: np.asarray(v) for n, v in t_res.params.items()}
+    _assert_bitwise(ref, res, "resume under mesh+zero1")
+
+
+def test_old_replicated_blob_restores_onto_zero_run():
+    mesh = _mesh2()
+    x, y, args = _exact_data()
+    t_rep, _ = _run_exact(x, y, args, mesh, 2)
+    blob = t_rep.get_opt_states()
+    t_z, _ = _run_exact(x, y, args, mesh, 1, zero=1)
+    t_z.set_opt_states(blob)
+    for a, b in zip(jax.tree.leaves(t_rep.opt_state),
+                    jax.tree.leaves(t_z.opt_state)):
+        assert (np.asarray(a) == np.asarray(b)).all()
+    t_z.step({"data": x, "lro_label": y})     # placement accepted by pjit
+
+
+# ----------------------------------------------------------------------
+# lint pass
+def test_zero_opt_state_lint_pass_fires_and_quiets():
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=512, name="big")
+    net = mx.symbol.FullyConnected(net, num_hidden=2, name="head")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+
+    def lint(zero):
+        t = parallel.Trainer(
+            sym, mx.optimizer.create("sgd", learning_rate=0.1,
+                                     momentum=0.9),
+            mesh=_mesh2(), zero=zero)
+        t.bind(data_shapes={"data": (8, 600)},
+               label_shapes={"softmax_label": (8,)})
+        t.init_params(mx.init.Xavier())
+        return t.lint()
+
+    rep = lint(0)
+    hits = [f for f in rep.findings if f.rule == "zero-opt-state"]
+    assert len(hits) == 1 and "big_weight" in hits[0].message
+    assert hits[0].severity == "warn"
+    assert not [f for f in lint(1).findings
+                if f.rule == "zero-opt-state"]
+
+
+# ----------------------------------------------------------------------
+# module / env threading
+def test_module_fit_under_env_zero_accum(monkeypatch):
+    monkeypatch.setenv("MXTPU_ZERO", "1")
+    monkeypatch.setenv("MXTPU_GRAD_ACCUM", "2")
+    from mxnet_tpu import io
+    mesh = parallel.make_mesh({"data": 4})
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 16).astype("f")
+    w = rng.randn(16, 4).astype("f")
+    y = np.argmax(x @ w, axis=1).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=4, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    train = io.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(sym, context=mesh)
+    mod.fit(train, num_epoch=8, kvstore="dist_sync_tpu",
+            optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5, "momentum": 0.9},
+            initializer=mx.init.Xavier())
+    t = mod._trainer
+    assert t is not None and t._zero_on and t.grad_accum == 2
+    assert t.opt_state_bytes_per_chip() > 0
+    train.reset()
+    assert mod.score(train, "acc")[0][1] > 0.9

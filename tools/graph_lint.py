@@ -47,6 +47,38 @@ def bench_targets():
     }
 
 
+def trainer_step_report():
+    """Lint the FUSED TRAINER STEP on a small data mesh — the only path
+    where the buffer-level passes (donation, zero-opt-state) have the
+    pjit metadata they need.  A momentum-SGD MLP with a >1 MB weight on
+    a 2-device data mesh, zero off: the checked-in baseline records the
+    expected zero-opt-state warn, so a change that silently loses (or
+    multiplies) the finding shows up as baseline drift.  Built on
+    virtual CPU devices (main() forces 2); on a 1-device platform the
+    mesh degrades to size 1 and the pass self-disables (warn drift is
+    informational — errors gate)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import analysis, parallel
+
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=512, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=4, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    devices = jax.devices()
+    mesh = parallel.make_mesh({"data": min(2, len(devices))}, devices)
+    trainer = parallel.Trainer(
+        sym, mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9),
+        mesh=mesh)
+    trainer.bind(data_shapes={"data": (8, 600)},
+                 label_shapes={"softmax_label": (8,)})
+    trainer.init_params(mx.init.Xavier())
+    report = analysis.lint_trainer(trainer)
+    report.model = "trainer-step"
+    return report
+
+
 def _parse_shapes(specs):
     """--shape name=(1,224,224,3) pairs -> dict."""
     import ast
@@ -93,6 +125,13 @@ def main(argv=None):
     # trace-time only: keep the gate off the chip (and off the tunnel)
     # unless the caller explicitly wants a platform
     if "MXTPU_LINT_PLATFORM" not in os.environ:
+        # two virtual host devices so the trainer-step target gets a real
+        # data mesh (must land before the first backend touch)
+        if "xla_force_host_platform_device_count" not in \
+                os.environ.get("XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=2")
         import jax
         jax.config.update("jax_platforms", "cpu")
 
@@ -111,11 +150,14 @@ def main(argv=None):
                 model=name)
     else:
         targets = bench_targets()
-        names = args.model or sorted(targets)
+        names = args.model or sorted(targets) + ["trainer-step"]
         for name in names:
+            if name == "trainer-step":
+                reports[name] = trainer_step_report()
+                continue
             if name not in targets:
-                raise SystemExit("unknown bench model %r (have %s)"
-                                 % (name, sorted(targets)))
+                raise SystemExit("unknown bench model %r (have %s, "
+                                 "trainer-step)" % (name, sorted(targets)))
             t = targets[name]
             reports[name] = analysis.lint_symbol(
                 t["sym"], shapes=t["shapes"], dtypes=t["dtypes"],
