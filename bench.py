@@ -583,6 +583,21 @@ def main():
         except Exception as e:                      # noqa: BLE001
             line["zero_ab_error"] = str(e)
 
+    # --- serving probe (docs/how_to/serving.md): the continuous-
+    # batching ModelServer under a bounded Poisson sweep — p50/p99
+    # latency, achieved vs offered rps, batch-occupancy, and the
+    # zero-steady-state-retrace assertion, next to the offline img/s
+    # numbers.  The committed INFER_BENCH.json `serving` section comes
+    # from the full `tools/serve_bench.py` run; this quick probe keeps
+    # the gate honest about the serve path.  MXTPU_BENCH_SERVING=0
+    # skips (5 small AOT compiles + ~2 s of load).
+    if os.environ.get("MXTPU_BENCH_SERVING", "1") != "0":
+        try:
+            from tools.serve_bench import serving_probe
+            line["serving"] = serving_probe(quick=True)
+        except Exception as e:                      # noqa: BLE001
+            line["serving_error"] = str(e)
+
     # --- streaming pipeline (datasets beyond HBM), wire-paced
     if on_tpu and os.environ.get("MXTPU_BENCH_STREAM_PROBE", "1") != "0":
         try:

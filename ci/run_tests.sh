@@ -137,6 +137,16 @@ stage "overlapped stream input pipeline (2-process decode ring, chunked H2D)"
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_stream_pipeline.py -q
 
+stage "serving layer (continuous batching / AOT shape buckets / fault isolation)"
+# the ModelServer suite: padding parity per bucket, zero-retrace steady
+# state across mixed request shapes, per-request poison isolation and
+# timeouts, multi-tenant hosting, the keyed compiled-forward cache.
+# HARD timeout: a wedged scheduler thread or a future that never
+# completes must FAIL this stage, not hang the suite —
+# docs/how_to/serving.md
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serving.py -q
+
 stage "fault-injection suite (sentinel / crash-resume / io recovery)"
 # every recovery path driven on demand via MXTPU_FAULTS — step sentinel
 # skip/abort, SIGKILL-faithful torn-checkpoint resume (subprocess),
@@ -154,10 +164,11 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_resilience.py, test_stream_pipeline.py and test_zero_accum.py
-# already ran as their own stages above
+# test_resilience.py, test_serving.py, test_stream_pipeline.py and
+# test_zero_accum.py already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_resilience.py \
+    --ignore=tests/test_serving.py \
     --ignore=tests/test_stream_pipeline.py \
     --ignore=tests/test_zero_accum.py \
     ${PYTEST_MARK[@]+"${PYTEST_MARK[@]}"}

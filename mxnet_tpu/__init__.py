@@ -117,6 +117,7 @@ from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import parallel
 from . import analysis
+from . import serving
 from . import faults
 from . import resilience
 from .resilience import CheckpointManager

@@ -21,7 +21,7 @@ from .core import (ERROR, INFO, WARN, SEVERITIES, Annotation, Finding,
                    GraphLintWarning, GraphPass, GraphView, LintReport,
                    NodeView, PassContext, annotate, get_pass, list_passes,
                    register_pass, run_passes)
-from .lint import lint_json, lint_symbol, lint_trainer
+from .lint import lint_json, lint_server, lint_symbol, lint_trainer
 from . import symbol_passes  # noqa: F401  registers the symbol passes
 from . import jaxpr_passes   # noqa: F401  registers the jaxpr passes
 from .baseline import (BASELINE_PATH, baseline_entry, check_baseline,
@@ -32,6 +32,7 @@ __all__ = [
     "GraphLintWarning", "GraphPass", "GraphView", "LintReport", "NodeView",
     "PassContext", "annotate", "get_pass", "list_passes", "register_pass",
     "run_passes", "lint_symbol", "lint_json", "lint_trainer",
+    "lint_server",
     "BASELINE_PATH", "baseline_entry", "check_baseline", "load_baseline",
     "write_baseline", "symbol_passes", "jaxpr_passes",
 ]

@@ -21,7 +21,7 @@ from .core import (ERROR, INFO, WARN, Finding, GraphPass, PassContext,
 
 __all__ = ["iter_eqns", "layer_of_eqn", "F64WideningPass",
            "HostCallbackPass", "DonationPass", "GatherScatterPass",
-           "ReplicatedOptStatePass"]
+           "ReplicatedOptStatePass", "ServeShapeBucketPass"]
 
 _SCOPE_RE = re.compile(r"^(transpose\()?(?:jvp\()?([A-Za-z0-9_.\-]+?)\)*$")
 
@@ -355,4 +355,48 @@ class GatherScatterPass(GraphPass):
                 "%d gather/scatter eqns in the step: %s" %
                 (total, ", ".join("%s x%d" % kv for kv in top[:5])),
                 detail={"counts": counts}))
+        return out
+
+
+@register_pass
+class ServeShapeBucketPass(GraphPass):
+    """Per-request-shape specialized compilations on the serve path.
+
+    The serving layer (``serving/server.py``) pre-compiles a fixed
+    bucket set of batch sizes at server start and pads every dispatched
+    batch to the next bucket, so steady state runs with ZERO retraces.
+    A forward compiled for a batch size OUTSIDE the bucket set means a
+    request slipped past the padding (an oversized request falling back
+    to an exact-shape trace, a direct ``CompiledForward.run`` at an ad
+    hoc shape) — each such compile stalls the serve loop for a full
+    trace+compile, exactly the latency spike continuous batching exists
+    to prevent.  Warn per (model, off-bucket size); the count of AOT
+    compiles beyond the bucket set is an error (the warmup itself is
+    mis-targeted).  Runs only on the ``lint_server`` path — it needs
+    the server's observed trace log (``serve_batch_sizes``) and bucket
+    set in ``ctx.config``.
+    """
+
+    name = "serve-shape-bucket"
+    level = "jaxpr"
+
+    def run(self, ctx: PassContext):
+        buckets = ctx.config.get("serve_buckets")
+        if not buckets:
+            return []
+        bset = set(int(b) for b in buckets)
+        out = []
+        for model, sizes in sorted(
+                (ctx.config.get("serve_batch_sizes") or {}).items()):
+            off = sorted({int(s) for s in sizes if int(s) not in bset})
+            if not off:
+                continue
+            hits = sum(1 for s in sizes if int(s) not in bset)
+            out.append(Finding(
+                self.name, WARN, model, "jit",
+                "%d serve-path compilation(s) at batch size(s) %s, "
+                "outside the AOT bucket set %s — each is a trace+compile "
+                "stall on the hot path; widen the buckets or cap request "
+                "rows" % (hits, off, sorted(bset)),
+                detail={"off_bucket_sizes": off, "buckets": sorted(bset)}))
         return out

@@ -8,6 +8,8 @@ Entry points:
   load path would drop).
 * :func:`lint_trainer` — lint a bound :class:`~..parallel.trainer.Trainer`'s
   fused step jaxpr, with buffer-donation metadata.
+* :func:`lint_server` — lint a :class:`~..serving.server.ModelServer`'s
+  observed serve-path compilations against its AOT bucket set.
 
 Everything is pure trace time: ``jax.eval_shape`` for the symbol walk,
 ``jax.make_jaxpr`` for the program — no device execution, so the CI
@@ -23,7 +25,7 @@ from ..base import MXNetError
 from .core import (ERROR, INFO, Finding, GraphView, LintReport, PassContext,
                    annotate, run_passes)
 
-__all__ = ["lint_symbol", "lint_json", "lint_trainer"]
+__all__ = ["lint_symbol", "lint_json", "lint_trainer", "lint_server"]
 
 
 def lint_symbol(sym, shapes: Optional[Dict[str, tuple]] = None,
@@ -239,6 +241,36 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
                       platform=trainer.prog.platform,
                       dtype_policy=trainer.dtype_policy, is_train=True,
                       config=lint_cfg)
+    report.extend(run_passes(ctx, "jaxpr", only))
+    report.traced = True
+    return report
+
+
+# ----------------------------------------------------------------------
+def lint_server(server, config: Optional[Dict[str, Any]] = None,
+                only=None) -> LintReport:
+    """Lint a :class:`~..serving.server.ModelServer`'s serve path.
+
+    Feeds the server's observed compilation log (every traced batch
+    size, per model — recorded by the shared ``CompiledForward``'s
+    trace-time counter) plus its AOT bucket set into the jaxpr-level
+    passes; the ``serve-shape-bucket`` pass warns on every forward
+    compiled for a batch size outside the bucket set (a request that
+    slipped past the padding and paid a trace+compile on the hot path).
+    No device execution and no re-trace: the log was collected as the
+    server ran."""
+    lint_cfg = dict(config or {})
+    lint_cfg.setdefault("serve_buckets", list(server.buckets))
+    # LAZY traces only: an AOT-registered signature (another server's
+    # bucket set, a Predictor's construction warmup on the shared
+    # compiled forward) is deliberate, not a hot-path stall.  Tenants
+    # sharing one compiled forward are reported as one joined entry so
+    # a shared stall isn't double-counted.
+    lint_cfg.setdefault("serve_batch_sizes", {
+        "+".join(names): list(cf.lazy_batch_sizes)
+        for cf, names in server._cf_groups()})
+    report = LintReport(model="serving")
+    ctx = PassContext(jaxpr=None, is_train=False, config=lint_cfg)
     report.extend(run_passes(ctx, "jaxpr", only))
     report.traced = True
     return report

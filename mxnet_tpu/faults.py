@@ -35,10 +35,19 @@ spec is installed):
 * ``crash`` — ``model._atomic_save`` (``site=ckpt_write``, ``save=``)
   calls ``os._exit(137)`` AFTER the tmp write and BEFORE the rename:
   a SIGKILL-faithful torn checkpoint, no atexit hooks, no flushes.
+* ``slow_request`` / ``poison_request`` — the serving layer
+  (``serving/server.py``; ``request=`` is the server's 1-based request
+  counter).  A slow request sleeps ``MXTPU_SERVE_SLOW_S`` during batch
+  assembly (a slow payload deserialize — its batch's latency spikes,
+  the queue behind it keeps coalescing); a poisoned request has its
+  payload NaN-filled, exercising per-request error isolation: the
+  output-finiteness check fails THAT future, the rest of the batch
+  completes (``docs/how_to/serving.md``).
 
 Example::
 
     MXTPU_FAULTS="nan_grad@step=3;io_error@batch=5:count=2;crash@ckpt_write"
+    MXTPU_FAULTS="poison_request@request=7;slow_request@request=12:count=3"
 """
 from __future__ import annotations
 

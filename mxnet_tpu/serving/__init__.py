@@ -1,0 +1,22 @@
+"""Production inference serving: continuous batching + AOT shape
+buckets over the compiled forward (ROADMAP item 1).
+
+* :class:`~.server.ModelServer` — thread-safe request queue, a
+  scheduler that coalesces concurrent requests onto accelerator-sized
+  batches, padding to ahead-of-time-compiled bucket sizes so the hot
+  path never retraces, per-request futures/timeouts/error isolation,
+  multi-tenant hosting (N symbols, one server).
+* :class:`~.compiled.CompiledForward` / :func:`~.compiled.compiled_forward`
+  — the keyed compiled-forward cache (weights as arguments) shared by
+  the server buckets and :class:`~..predictor.Predictor`.
+
+Architecture walkthrough: ``docs/how_to/serving.md``.  Load generator /
+bench: ``tools/serve_bench.py`` (INFER_BENCH.json ``serving`` section).
+"""
+from .compiled import (CompiledForward, cache_stats, clear_cache,
+                       compiled_forward)
+from .server import ModelServer, ServeError, ServeFuture, ServeTimeout
+
+__all__ = ["ModelServer", "ServeFuture", "ServeError", "ServeTimeout",
+           "CompiledForward", "compiled_forward", "cache_stats",
+           "clear_cache"]

@@ -1,0 +1,262 @@
+"""Keyed compiled-forward cache: one jitted eval program per
+(symbol, inputs, platform, policy), shared by every consumer.
+
+The ``Predictor`` path used to ``bind`` per instance — a second
+``Predictor.from_checkpoint`` of the SAME model re-traced and re-compiled
+the identical forward.  Serving makes that untenable: a bucket set of
+five batch sizes times N tenant models would pay 5N compiles per process
+*per object*.  Here the unit of compilation is a :class:`CompiledForward`
+— the symbol's eval-mode forward with **weights as arguments** (the same
+trick the fused trainer step uses), so
+
+* the compiled program is weight-independent: every Predictor / server
+  bucket over the same (symbol, input names, platform, policy) shares
+  ONE entry and ONE jit cache, and
+* the weights live on device once per model, passed by reference into
+  whichever bucket executable runs — no per-bucket copies, no rebind.
+
+Retrace accounting: the traced python body bumps ``trace_count`` (jax
+runs it exactly once per distinct input signature), and
+``aot_compile`` records the deliberately pre-compiled signatures; any
+excess of ``trace_count`` over the AOT set is a **retrace** — a shape
+that slipped past the bucket padding.  ``ModelServer`` asserts this
+stays zero in steady state, and the ``serve-shape-bucket`` lint pass
+(``analysis/jaxpr_passes.py``) flags the offending batch sizes.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..executor import _GraphProgram
+
+__all__ = ["CompiledForward", "compiled_forward", "cache_stats",
+           "clear_cache", "infer_input_dtypes"]
+
+
+def infer_input_dtypes(symbol, params, input_names: Sequence[str],
+                       declared: Optional[Dict] = None) -> Dict:
+    """The staging dtype per input: declared by the caller > what
+    ``infer_type`` back-derives from the LOADED param dtypes (a bf16
+    checkpoint binds bf16 inputs) > float32.  One rule shared by the
+    Predictor and the serving buckets — both stage requests through it,
+    so the same checkpoint serves identically on either path."""
+    inferred = {}
+    try:
+        types, _, _ = symbol.infer_type(
+            **{n: np.dtype(v.dtype) for n, v in params.items()})
+        inferred = {n: t for n, t in zip(symbol.list_arguments(), types)
+                    if t is not None}
+    except MXNetError:
+        pass
+    out = {}
+    for n in input_names:
+        if declared and n in declared:
+            out[n] = np.dtype(declared[n])
+        else:
+            out[n] = np.dtype(inferred.get(n, np.float32))
+    return out
+
+
+class CompiledForward:
+    """A symbol's inference forward, jitted once, weights as arguments.
+
+    ``run(params, aux, batch)`` executes at whatever batch signature the
+    inputs carry; signatures registered through :meth:`aot_compile`
+    execute from the ahead-of-time compiled cache (zero trace work on
+    the hot path — ``jit.lower().compile()`` shares the jit's executable
+    cache, verified on this jax), anything else traces on first use and
+    counts as a retrace.
+    """
+
+    def __init__(self, symbol, input_names: Sequence[str],
+                 platform: Optional[str] = None,
+                 dtype_policy: Optional[str] = None):
+        self.symbol = symbol
+        self.prog = _GraphProgram(symbol)
+        if platform is not None:
+            self.prog.platform = platform
+        self.prog.dtype_policy = dtype_policy
+        self.input_names = tuple(input_names)
+        missing = [n for n in self.input_names
+                   if n not in self.prog.arg_names]
+        if missing:
+            raise MXNetError("inputs %s are not arguments of this symbol "
+                             "(have %s)" % (missing, self.prog.arg_names))
+        self.param_names = [n for n in self.prog.arg_names
+                            if n not in set(self.input_names)]
+        self.aux_names = list(self.prog.aux_names)
+        self.trace_count = 0            # bumped in the traced body
+        self.traced_batch_sizes: List[int] = []   # one entry per trace
+        # traces that happened OUTSIDE an aot_compile call — each one
+        # was a trace+compile stall on some caller's hot path.  A
+        # Predictor's construction-time warmup or a server bucket is
+        # AOT; only lazy traces count as retraces / lint findings.
+        self.lazy_batch_sizes: List[int] = []
+        self._aot_keys: set = set()     # signatures compiled at startup
+        self._aot_tls = threading.local()
+        self._lock = threading.Lock()
+        # eval-mode RNG: one constant key.  Serving is deterministic by
+        # contract — a model whose eval forward draws (sampling heads)
+        # gets the same stream every call; per-call keys would make the
+        # padded-bucket outputs request-order dependent.
+        self._rng = jax.random.key(0)
+
+        param_set = set(self.param_names)
+        arg_names = list(self.prog.arg_names)
+        aux_names = self.aux_names
+
+        def _fwd(params, aux, batch, key):
+            # trace-time side effects: jax runs this body exactly once
+            # per distinct input signature — the compilation counter.
+            # The AOT flag is thread-local: aot_compile's lower() runs
+            # the trace on the calling thread, so a concurrent lazy
+            # trace on another thread is still attributed correctly.
+            with self._lock:
+                self.trace_count += 1
+                b = self._batch_dim(batch)
+                self.traced_batch_sizes.append(b)
+                if not getattr(self._aot_tls, "active", False):
+                    self.lazy_batch_sizes.append(b)
+            vals = [params[n] if n in param_set else batch[n]
+                    for n in arg_names]
+            outs, _ = self.prog._eval(vals, [aux[n] for n in aux_names],
+                                      key, False)
+            return outs
+
+        self._jit = jax.jit(_fwd)
+
+    # ------------------------------------------------------------------
+    def _batch_dim(self, batch) -> int:
+        for n in self.input_names:
+            v = batch.get(n)
+            if v is not None and getattr(v, "shape", None):
+                return int(v.shape[0])
+        return 0
+
+    @staticmethod
+    def _sig(batch) -> Tuple:
+        # sharding is part of the jit signature: the same shapes warmed
+        # replicated and mesh-sharded are two distinct compilations
+        return tuple(sorted((n, tuple(v.shape), str(np.dtype(v.dtype)),
+                             str(getattr(v, "sharding", None)))
+                            for n, v in batch.items()))
+
+    def aot_compile(self, params, aux, batch_shapes: Dict[str, tuple],
+                    batch_dtypes: Optional[Dict] = None,
+                    batch_shardings: Optional[Dict] = None) -> None:
+        """Lower + compile one input signature ahead of time (server
+        start / Predictor bind).  ``params``/``aux`` provide the weight
+        avals (values or ShapeDtypeStructs — only shape/dtype/sharding
+        are read).  On a mesh the caller passes ``batch_shardings`` so
+        the warmed signature matches the committed batches the hot path
+        feeds — a signature mismatch here would silently turn every
+        "pre-compiled" call into a retrace."""
+        batch_dtypes = batch_dtypes or {}
+        batch_shardings = batch_shardings or {}
+        sds = {n: jax.ShapeDtypeStruct(
+            tuple(s), np.dtype(batch_dtypes.get(n, np.float32)),
+            sharding=batch_shardings.get(n))
+            for n, s in batch_shapes.items()}
+        key = self._sig(sds)
+        if key in self._aot_keys:
+            return
+
+        def _wsds(v):
+            sh = getattr(v, "sharding", None)
+            committed = getattr(v, "_committed", False)
+            return jax.ShapeDtypeStruct(
+                v.shape, v.dtype, sharding=sh if committed else None)
+
+        p_sds = {n: _wsds(v) for n, v in params.items()}
+        a_sds = {n: _wsds(v) for n, v in aux.items()}
+        # .lower() traces (counted once by _fwd); .compile() lands the
+        # executable in the jit cache, so the later run() at this
+        # signature is a pure cache hit
+        self._aot_tls.active = True
+        try:
+            self._jit.lower(p_sds, a_sds, sds, self._rng).compile()
+        finally:
+            self._aot_tls.active = False
+        self._aot_keys.add(key)
+
+    def run(self, params, aux, batch: Dict) -> Tuple:
+        """Execute the forward.  ``batch`` maps every input name to a
+        host or device array; returns the output tuple (device
+        arrays)."""
+        return self._jit(params, aux, batch, self._rng)
+
+    # ------------------------------------------------------------------
+    @property
+    def aot_count(self) -> int:
+        return len(self._aot_keys)
+
+    @property
+    def retraces(self) -> int:
+        """Lazy (non-AOT) compilations — each one was a trace+compile
+        stall on some caller's hot path, a shape the bucket padding (or
+        a Predictor's construction warmup) should have absorbed."""
+        return len(self.lazy_batch_sizes)
+
+    def offbucket_batch_sizes(self, buckets: Sequence[int]) -> List[int]:
+        """Lazily-traced batch sizes not in ``buckets`` (lint
+        provenance; AOT-registered signatures — other servers' buckets,
+        Predictor warmups — are deliberate and exempt)."""
+        bset = set(int(b) for b in buckets)
+        return sorted({b for b in self.lazy_batch_sizes
+                       if b not in bset})
+
+
+# ----------------------------------------------------------------------
+_CACHE: Dict[Tuple, CompiledForward] = {}
+_CACHE_LOCK = threading.Lock()
+_HITS = 0
+_MISSES = 0
+
+
+def _symbol_digest(symbol) -> str:
+    return hashlib.sha1(symbol.tojson().encode()).hexdigest()
+
+
+def compiled_forward(symbol, input_names: Sequence[str],
+                     platform: Optional[str] = None,
+                     dtype_policy: Optional[str] = None) -> CompiledForward:
+    """The process-wide keyed cache.  Key = (symbol JSON digest, input
+    partition, platform, dtype policy): two Predictors (or server
+    tenants) over the same saved model resolve to the SAME
+    CompiledForward, so the second one compiles nothing."""
+    global _HITS, _MISSES
+    key = (_symbol_digest(symbol), tuple(sorted(input_names)),
+           platform, dtype_policy)
+    with _CACHE_LOCK:
+        cf = _CACHE.get(key)
+        if cf is not None:
+            _HITS += 1
+            return cf
+        _MISSES += 1
+    # build outside the lock (graph walk), publish under it; a racing
+    # duplicate build is harmless — first writer wins
+    cf = CompiledForward(symbol, input_names, platform, dtype_policy)
+    with _CACHE_LOCK:
+        return _CACHE.setdefault(key, cf)
+
+
+def cache_stats() -> Dict[str, int]:
+    with _CACHE_LOCK:
+        return {"entries": len(_CACHE), "hits": _HITS, "misses": _MISSES,
+                "traces": sum(cf.trace_count for cf in _CACHE.values())}
+
+
+def clear_cache() -> None:
+    global _HITS, _MISSES
+    with _CACHE_LOCK:
+        _CACHE.clear()
+        _HITS = 0
+        _MISSES = 0

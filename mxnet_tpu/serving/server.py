@@ -1,0 +1,679 @@
+"""Continuous-batching model server over AOT-compiled shape buckets.
+
+The production serving story (ROADMAP item 1): the paper's deploy
+surface is the predict-only C API — one request, one forward.  A TPU
+earns its keep at batch 16-32, so a server fronting many concurrent
+clients must coalesce requests onto accelerator-sized batches (the
+TensorFlow serving design) while never paying a trace/compile on the
+hot path (TVM's pre-compiled-variants insight).  Both halves live here:
+
+* **continuous batching** — ``submit()`` enqueues a request and returns
+  a :class:`ServeFuture`; a scheduler thread drains the queue into the
+  largest admissible batch each cycle (dispatch when the pending rows
+  reach ``cap`` or the oldest request has waited ``max_wait_us``),
+  slices the batched outputs back per request, and completes futures.
+* **AOT shape buckets** — the batch is padded to the next compiled
+  bucket size (default 1/4/8/16/32); every bucket of every model is
+  lowered+compiled at ``start()`` through the shared
+  :class:`~.compiled.CompiledForward` cache, so steady state runs with
+  **zero retraces** (asserted via the trace counter;
+  ``assert_no_retrace()`` / the ``serve-shape-bucket`` lint pass).
+
+Weights live on device once per model and are passed by reference into
+whichever bucket executable fires — multi-tenant hosting is just
+``add_model`` called N times on one server (N symbols, one scheduler,
+one compiled-forward cache).  Fault handling: the ``MXTPU_FAULTS`` DSL
+(``faults.py``) can mark requests slow (``slow_request@request=K``) or
+poisoned (``poison_request@request=K``); a poisoned payload fails ITS
+OWN future via the per-request output-finiteness check while the rest
+of the batch completes, and expired requests fail with a timeout before
+ever entering a batch.
+
+Knobs (constructor arg wins over ``MXTPU_SERVE_*`` env):
+
+====================  =========================  =======================
+constructor            env                        default
+====================  =========================  =======================
+``buckets``           ``MXTPU_SERVE_BUCKETS``    ``1,4,8,16,32``
+``max_wait_us``       ``MXTPU_SERVE_MAX_WAIT_US``  ``2000``
+``cap``               ``MXTPU_SERVE_CAP``        largest bucket
+``timeout_ms``        ``MXTPU_SERVE_TIMEOUT_MS`` ``10000`` (0 = off)
+``validate``          ``MXTPU_SERVE_VALIDATE``   ``1`` (finiteness check)
+====================  =========================  =======================
+
+See ``docs/how_to/serving.md`` for the architecture walkthrough and
+``tools/serve_bench.py`` for the Poisson load generator that produces
+INFER_BENCH.json's ``serving`` section.
+"""
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import faults as _faults
+from .compiled import CompiledForward, compiled_forward
+
+__all__ = ["ModelServer", "ServeFuture", "ServeTimeout", "ServeError"]
+
+
+class ServeError(MXNetError):
+    """A request failed inside the server (poisoned payload, shutdown)."""
+
+
+class ServeTimeout(ServeError):
+    """A request's deadline expired before it was served."""
+
+
+class ServeFuture:
+    """Completion handle for one submitted request."""
+
+    __slots__ = ("_done", "_result", "_exc", "t_submit", "t_done")
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._result = None
+        self._exc = None
+        self.t_submit = time.perf_counter()
+        self.t_done = None
+
+    def _set_result(self, outs):
+        self._result = outs
+        self.t_done = time.perf_counter()
+        self._done.set()
+
+    def _set_exception(self, exc):
+        self._exc = exc
+        self.t_done = time.perf_counter()
+        self._done.set()
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
+        """Block for the outputs (one array per graph output, leading
+        dim = this request's row count).  Raises what the request
+        raised."""
+        if not self._done.wait(timeout):
+            raise ServeTimeout("request not completed within %ss" % timeout)
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise ServeTimeout("request not completed within %ss" % timeout)
+        return self._exc
+
+    @property
+    def latency_s(self) -> Optional[float]:
+        return None if self.t_done is None else self.t_done - self.t_submit
+
+
+class _Request:
+    __slots__ = ("rid", "inputs", "n", "future", "t_in", "deadline",
+                 "slow", "poisoned")
+
+    def __init__(self, rid, inputs, n, deadline):
+        self.rid = rid
+        self.inputs = inputs
+        self.n = n
+        self.future = ServeFuture()
+        self.t_in = time.perf_counter()
+        self.deadline = None if deadline is None else self.t_in + deadline
+        self.slow = _faults.hit("slow_request", request=rid)
+        self.poisoned = _faults.hit("poison_request", request=rid)
+
+
+class _Model:
+    """One tenant: symbol + device-resident weights + shared compiled
+    forward + per-model request queue."""
+
+    __slots__ = ("name", "symbol", "cf", "params", "aux", "example_shapes",
+                 "label_trailing", "input_dtypes", "queue", "pending",
+                 "n_outputs")
+
+    def __init__(self, name, symbol, cf, params, aux, example_shapes,
+                 label_trailing, input_dtypes, n_outputs):
+        self.name = name
+        self.symbol = symbol
+        self.cf = cf
+        self.params = params
+        self.aux = aux
+        self.example_shapes = example_shapes    # data input -> trailing dims
+        self.label_trailing = label_trailing    # label input -> trailing dims
+        self.input_dtypes = input_dtypes
+        self.queue = collections.deque()
+        # queued rows, maintained under _cond — a full-queue scan per
+        # scheduler wakeup would make draining a backlog quadratic
+        self.pending = 0
+        self.n_outputs = n_outputs
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        raise MXNetError("%s=%r is not an integer"
+                         % (name, os.environ.get(name))) from None
+
+
+class ModelServer:
+    """Thread-safe continuous-batching server over one or more models."""
+
+    def __init__(self, buckets: Optional[Sequence[int]] = None,
+                 max_wait_us: Optional[int] = None,
+                 cap: Optional[int] = None,
+                 timeout_ms: Optional[int] = None,
+                 validate: Optional[bool] = None,
+                 mesh=None):
+        if buckets is None:
+            buckets = [int(b) for b in os.environ.get(
+                "MXTPU_SERVE_BUCKETS", "1,4,8,16,32").split(",") if b]
+        self.buckets = sorted(set(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise MXNetError("buckets must be positive ints, got %s"
+                             % (buckets,))
+        self.max_wait_s = (max_wait_us if max_wait_us is not None
+                           else _env_int("MXTPU_SERVE_MAX_WAIT_US",
+                                         2000)) / 1e6
+        self.cap = int(cap) if cap is not None \
+            else _env_int("MXTPU_SERVE_CAP", self.buckets[-1])
+        timeout_ms = timeout_ms if timeout_ms is not None \
+            else _env_int("MXTPU_SERVE_TIMEOUT_MS", 10000)
+        self.timeout_s = (timeout_ms / 1e3) if timeout_ms else None
+        if validate is None:
+            validate = os.environ.get("MXTPU_SERVE_VALIDATE", "1") != "0"
+        self.validate = bool(validate)
+        self.mesh = mesh
+        self._data_axis = 1
+        if mesh is not None:
+            self._data_axis = int(dict(mesh.shape).get("data", 1))
+        if self._data_axis > 1:
+            bad = [b for b in self.buckets if b % self._data_axis]
+            if bad:
+                raise MXNetError(
+                    "buckets %s are not divisible by the mesh data-axis "
+                    "size %d — row-sharded batches need divisible bucket "
+                    "sizes (e.g. buckets=%s)"
+                    % (bad, self._data_axis,
+                       sorted({max(self._data_axis,
+                                   -(-b // self._data_axis)
+                                   * self._data_axis)
+                               for b in self.buckets})))
+        self._models: Dict[str, _Model] = {}
+        self._cond = threading.Condition()
+        self._thread = None
+        self._stop = False
+        self._started = False
+        self._rid = 0
+        # counters (all mutated under _cond)
+        self._stats = {"requests": 0, "completed": 0, "failed": 0,
+                       "timeouts": 0, "batches": 0, "rows_real": 0,
+                       "rows_padded": 0}
+        self._occupancy: Dict[int, List[int]] = {}   # bucket -> [batches, rows]
+
+    # ------------------------------------------------------------------
+    def _placed(self, value, spec=None):
+        """One-time weight placement: replicated (or ``spec``-sharded)
+        on the mesh when one is given — the trainer's placement
+        machinery, not a per-instance bind."""
+        raw = value.data if isinstance(value, NDArray) else jnp.asarray(
+            np.asarray(value))
+        if self.mesh is None:
+            return raw
+        from jax.sharding import NamedSharding, PartitionSpec
+        return jax.device_put(raw, NamedSharding(self.mesh,
+                                                 spec or PartitionSpec()))
+
+    def add_model(self, name: str, symbol, arg_params: Dict,
+                  aux_params: Optional[Dict] = None,
+                  input_shapes: Optional[Dict[str, Sequence[int]]] = None,
+                  input_dtypes: Optional[Dict] = None) -> None:
+        """Register a tenant.  ``input_shapes`` maps each data input to
+        its PER-EXAMPLE shape (no batch dim); label arguments are
+        auto-detected and zero-filled per bucket.  ``input_dtypes``
+        defaults to what ``infer_type`` derives from the param dtypes
+        (so bf16/int8 checkpoints serve in their own dtype)."""
+        if self._started:
+            raise MXNetError("add_model before start() (bucket compiles "
+                             "happen at server start)")
+        if name in self._models:
+            raise MXNetError("model %r already registered" % name)
+        if not input_shapes:
+            raise MXNetError("input_shapes (per-example, no batch dim) "
+                             "required")
+        aux_params = aux_params or {}
+        example_shapes = {k: tuple(v) for k, v in input_shapes.items()}
+
+        arg_names = symbol.list_arguments()
+        param_names = [n for n in arg_names
+                       if n not in example_shapes and n in arg_params]
+        label_names = [n for n in arg_names
+                       if n not in example_shapes and n not in arg_params]
+        bad = [n for n in label_names if not n.endswith("label")]
+        if bad:
+            raise MXNetError("arguments %s are neither declared inputs, "
+                             "loaded params, nor *label inputs" % bad)
+
+        # shape bookkeeping at a reference batch: label trailing dims,
+        # batch-major output check (the slicer hands rows back per
+        # request — a reduced head would be silently mis-split)
+        ref_b = 2
+        ref_shapes = {n: (ref_b,) + s for n, s in example_shapes.items()}
+        arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**ref_shapes)
+        shape_of = dict(zip(arg_names, arg_shapes))
+        label_trailing = {}
+        for n in label_names:
+            s = shape_of[n]
+            if not s or s[0] != ref_b:
+                raise MXNetError("label input %r is not batch-major "
+                                 "(shape %s)" % (n, s))
+            label_trailing[n] = tuple(s[1:])
+        for oname, oshape in zip(symbol.list_outputs(), out_shapes or []):
+            if not oshape or oshape[0] != ref_b:
+                raise MXNetError(
+                    "output %r has shape %s — the request slicer needs "
+                    "batch-major outputs (reduced heads are not "
+                    "servable)" % (oname, tuple(oshape or ())))
+
+        params = {n: self._placed(arg_params[n]) for n in param_names}
+        missing = [n for n in arg_names
+                   if n not in example_shapes and n not in params
+                   and n not in label_names]
+        if missing:
+            raise MXNetError("params %s missing from arg_params" % missing)
+        aux_names = symbol.list_auxiliary_states()
+        aux = {}
+        for n, s in zip(aux_names, aux_shapes):
+            aux[n] = self._placed(aux_params[n]) if n in aux_params \
+                else self._placed(np.zeros(s, np.float32))
+
+        # input dtypes: declared > back-inferred from param dtypes > f32
+        # (the SAME rule the Predictor binds with — shared helper)
+        from .compiled import infer_input_dtypes
+        dtypes = infer_input_dtypes(
+            symbol, params, list(example_shapes) + label_names,
+            declared=input_dtypes)
+
+        cf = compiled_forward(
+            symbol, list(example_shapes) + label_names,
+            platform=self._platform(params))
+        self._models[name] = _Model(
+            name, symbol, cf, params, aux, example_shapes, label_trailing,
+            dtypes, len(symbol.list_outputs()))
+
+    def _platform(self, params):
+        try:
+            first = next(iter(params.values()))
+            plat = next(iter(first.devices())).platform
+        except Exception:                         # noqa: BLE001
+            plat = jax.default_backend()
+        return "tpu" if plat in ("tpu", "axon") else plat
+
+    # ------------------------------------------------------------------
+    def start(self) -> "ModelServer":
+        """AOT-compile every (model, bucket) pair, then start the
+        scheduler.  After this returns, steady-state serving never
+        traces (``assert_no_retrace``)."""
+        if self._started:
+            return self
+        if not self._models:
+            raise MXNetError("add_model first")
+        for m in self._models.values():
+            for b in self.buckets:
+                shapes = self._bucket_shapes(m, b)
+                shardings = None
+                if self.mesh is not None:
+                    from ..parallel.mesh import batch_sharding
+                    shardings = {n: batch_sharding(self.mesh, len(s))
+                                 for n, s in shapes.items()}
+                m.cf.aot_compile(m.params, m.aux, shapes, m.input_dtypes,
+                                 batch_shardings=shardings)
+                # one REAL zero-batch execution per bucket: lower+compile
+                # leaves a first-call dispatch cost (~100-230 ms measured
+                # on the CPU tier — executable load, result-handler and
+                # fast-path setup) that would otherwise land on the
+                # first live request of each bucket; no tracing happens
+                # here (the trace counter stays at the AOT count)
+                feed = {n: np.zeros(s, m.input_dtypes[n])
+                        for n, s in shapes.items()}
+                if self.mesh is not None:
+                    feed = {n: jax.device_put(v, shardings[n])
+                            for n, v in feed.items()}
+                outs = m.cf.run(m.params, m.aux, feed)
+                np.asarray(outs[0][:1])     # completion barrier
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="mxtpu-serve-sched",
+                                        daemon=True)
+        self._started = True
+        self._thread.start()
+        return self
+
+    def _bucket_shapes(self, m: _Model, b: int) -> Dict[str, tuple]:
+        shapes = {n: (b,) + s for n, s in m.example_shapes.items()}
+        shapes.update({n: (b,) + s for n, s in m.label_trailing.items()})
+        return shapes
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # drain + close the door under ONE lock acquisition: a submit
+        # racing stop() either lands before the drain (and is failed
+        # here) or sees _started False and raises — no request can slip
+        # in after the drain and hang its future forever
+        leftovers = []
+        with self._cond:
+            for m in self._models.values():
+                while m.queue:
+                    leftovers.append(m.queue.popleft())
+                m.pending = 0
+            self._started = False
+        for r in leftovers:
+            r.future._set_exception(ServeError("server stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------------
+    def submit(self, inputs: Optional[Dict] = None, model: Optional[str] = None,
+               **kw) -> ServeFuture:
+        """Enqueue one request; returns its :class:`ServeFuture`.
+
+        Each input is either one example (exactly the per-example
+        shape) or a stack of them (leading request-row dim); all inputs
+        of a request must agree on the row count."""
+        m = self._resolve(model)
+        inputs = dict(inputs or {}, **kw)
+        arrs, n = {}, None
+        for iname, trailing in m.example_shapes.items():
+            if iname not in inputs:
+                raise MXNetError("request missing input %r" % iname)
+            a = np.asarray(inputs[iname])
+            if tuple(a.shape) == trailing:
+                a = a[None]
+            elif a.ndim != len(trailing) + 1 \
+                    or tuple(a.shape[1:]) != trailing:
+                raise MXNetError(
+                    "input %r shape %s matches neither the per-example "
+                    "shape %s nor (n,)+%s"
+                    % (iname, a.shape, trailing, trailing))
+            if n is None:
+                n = a.shape[0]
+            elif a.shape[0] != n:
+                raise MXNetError("request inputs disagree on row count "
+                                 "(%d vs %d for %r)" % (n, a.shape[0], iname))
+            # cast HERE, once, to the bound dtype — the batch assembler
+            # concatenates like-dtype parts with no further copies
+            arrs[iname] = np.ascontiguousarray(
+                a, dtype=m.input_dtypes[iname])
+        extra = set(inputs) - set(m.example_shapes)
+        if extra:
+            raise MXNetError("unknown inputs %s for model %r"
+                             % (sorted(extra), m.name))
+        with self._cond:
+            # started-check under the lock: see stop() — the enqueue and
+            # the shutdown drain are serialized, so a future either gets
+            # served, failed by the drain, or refused here
+            if not self._started or self._stop:
+                raise MXNetError("server not started")
+            self._rid += 1
+            req = _Request(self._rid, arrs, n, self.timeout_s)
+            m.queue.append(req)
+            m.pending += n
+            self._stats["requests"] += 1
+            self._cond.notify_all()
+        return req.future
+
+    def predict(self, inputs: Optional[Dict] = None,
+                model: Optional[str] = None, **kw) -> List[np.ndarray]:
+        """submit + block: the synchronous convenience surface."""
+        return self.submit(inputs, model=model, **kw).result()
+
+    def _resolve(self, model: Optional[str]) -> _Model:
+        if model is None:
+            if len(self._models) != 1:
+                raise MXNetError("model= required on a multi-tenant "
+                                 "server (have %s)" % sorted(self._models))
+            return next(iter(self._models.values()))
+        if model not in self._models:
+            raise MXNetError("unknown model %r (have %s)"
+                             % (model, sorted(self._models)))
+        return self._models[model]
+
+    # ------------------------------------------------------------------
+    # scheduler
+    def _loop(self):
+        while True:
+            with self._cond:
+                if self._stop:
+                    return
+                wait = self._next_due_s()
+                if wait is None or wait > 0:
+                    self._cond.wait(timeout=wait)
+                if self._stop:
+                    return
+            for m in list(self._models.values()):
+                batch = self._take_batch(m)
+                if not batch:
+                    continue
+                try:
+                    self._run_batch(m, batch)
+                except Exception as e:              # noqa: BLE001
+                    # the scheduler thread must OUTLIVE any one bad
+                    # batch: fail these futures, keep serving the rest
+                    with self._cond:
+                        self._stats["failed"] += sum(
+                            1 for r in batch if not r.future.done())
+                    for r in batch:
+                        if not r.future.done():
+                            r.future._set_exception(ServeError(
+                                "serve cycle failed: %s" % e))
+
+    def _next_due_s(self) -> Optional[float]:
+        """Seconds until the earliest queue needs attention (None =
+        nothing pending, sleep until notified)."""
+        now = time.perf_counter()
+        due = None
+        for m in self._models.values():
+            if not m.queue:
+                continue
+            head = m.queue[0]
+            t = head.t_in + self.max_wait_s
+            if head.deadline is not None:
+                t = min(t, head.deadline)
+            if m.pending >= self.cap:
+                t = now
+            due = t if due is None else min(due, t)
+        if due is None:
+            return None
+        return max(0.0, due - now)
+
+    def _take_batch(self, m: _Model) -> List[_Request]:
+        """Pop the next admissible batch (largest prefix of the queue
+        within ``cap`` rows) — or nothing if the coalescing window is
+        still open.  Expired requests fail here, before ever entering a
+        batch."""
+        now = time.perf_counter()
+        expired = []
+        with self._cond:
+            while m.queue and m.queue[0].deadline is not None \
+                    and m.queue[0].deadline <= now:
+                r = m.queue.popleft()
+                m.pending -= r.n
+                expired.append(r)
+            if expired:
+                self._stats["timeouts"] += len(expired)
+                self._stats["failed"] += len(expired)
+            if not m.queue:
+                batch = []
+            else:
+                waited = now - m.queue[0].t_in
+                if m.pending < self.cap and waited < self.max_wait_s:
+                    batch = []
+                else:
+                    batch, total = [], 0
+                    while m.queue:
+                        r = m.queue[0]
+                        if total and total + r.n > self.cap:
+                            break
+                        batch.append(m.queue.popleft())
+                        m.pending -= r.n
+                        total += r.n
+                        if total >= self.cap:
+                            break
+        for r in expired:
+            r.future._set_exception(ServeTimeout(
+                "request %d expired after %.0f ms in queue"
+                % (r.rid, (now - r.t_in) * 1e3)))
+        return batch
+
+    def _bucket_for(self, total: int) -> Optional[int]:
+        for b in self.buckets:
+            if b >= total:
+                return b
+        return None
+
+    def _run_batch(self, m: _Model, batch: List[_Request]) -> None:
+        total = sum(r.n for r in batch)
+        bucket = self._bucket_for(total)
+        padded = bucket
+        if padded is None:
+            # oversized fallback: exact shape — except on a mesh, where
+            # the row-sharded batch dim must stay divisible
+            padded = -(-total // self._data_axis) * self._data_axis
+        # assemble the padded device batch; a slow request stalls only
+        # its own cycle (the fault models a slow payload deserialize)
+        for r in batch:
+            if r.slow:
+                time.sleep(float(os.environ.get("MXTPU_SERVE_SLOW_S",
+                                                "0.05")))
+        feed = {}
+        for iname, trailing in m.example_shapes.items():
+            dt = m.input_dtypes[iname]
+            parts = []
+            for r in batch:
+                a = r.inputs[iname]
+                # jnp.issubdtype, NOT np: bfloat16 is an ml_dtypes
+                # extension type that numpy does not class as floating
+                if r.poisoned and jnp.issubdtype(dt, jnp.floating):
+                    a = np.full(a.shape, np.nan, dt)
+                parts.append(a)
+            if padded > total:
+                parts.append(np.zeros((padded - total,) + trailing, dt))
+            feed[iname] = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts, axis=0)
+        for lname, trailing in m.label_trailing.items():
+            feed[lname] = np.zeros((padded,) + trailing,
+                                   m.input_dtypes[lname])
+        if self.mesh is not None:
+            # the trainer's batch placement: dim 0 sharded along "data"
+            from ..parallel.mesh import batch_sharding
+            feed = {n: jax.device_put(
+                v, batch_sharding(self.mesh, np.ndim(v)))
+                for n, v in feed.items()}
+        try:
+            outs = m.cf.run(m.params, m.aux, feed)
+            outs_np = [np.asarray(o) for o in outs]
+        except Exception as e:                        # noqa: BLE001
+            with self._cond:
+                self._stats["failed"] += len(batch)
+            for r in batch:
+                r.future._set_exception(ServeError(
+                    "batched forward failed: %s" % e))
+            return
+        with self._cond:
+            self._stats["batches"] += 1
+            self._stats["rows_real"] += total
+            self._stats["rows_padded"] += padded
+            occ = self._occupancy.setdefault(padded, [0, 0])
+            occ[0] += 1
+            occ[1] += total
+        off = 0
+        for r in batch:
+            rows = [o[off:off + r.n] for o in outs_np]
+            off += r.n
+            bad = self.validate and any(
+                jnp.issubdtype(o.dtype, jnp.floating)
+                and not np.all(np.isfinite(o)) for o in rows)
+            with self._cond:
+                self._stats["failed" if bad else "completed"] += 1
+            if bad:
+                r.future._set_exception(ServeError(
+                    "request %d produced non-finite outputs (poisoned "
+                    "or invalid payload); the rest of the batch was "
+                    "unaffected" % r.rid))
+            else:
+                r.future._set_result(rows)
+
+    # ------------------------------------------------------------------
+    # observability
+    def stats(self) -> Dict:
+        """Counters + batch-occupancy histogram + retrace accounting."""
+        with self._cond:
+            s = dict(self._stats)
+            occ = {str(b): {"batches": v[0],
+                            "mean_fill": round(v[1] / (v[0] * b), 3)}
+                   for b, v in sorted(self._occupancy.items())}
+            depth = sum(len(m.queue) for m in self._models.values())
+        s["occupancy"] = occ
+        s["padding_frac"] = round(
+            1.0 - s["rows_real"] / s["rows_padded"], 4) \
+            if s["rows_padded"] else 0.0
+        s["queue_depth"] = depth
+        s["buckets"] = list(self.buckets)
+        cfs = self._cf_groups()
+        s["aot_compiles"] = sum(cf.aot_count for cf, _ in cfs)
+        s["retraces"] = sum(cf.retraces for cf, _ in cfs)
+        s["models"] = sorted(self._models)
+        return s
+
+    def _cf_groups(self):
+        """``(cf, [model names])`` with shared compiled forwards
+        deduplicated — two tenants over the same symbol (an A/B of two
+        checkpoints of one architecture) share ONE CompiledForward, and
+        summing it per model would double-count its traces."""
+        groups = {}
+        for name in sorted(self._models):
+            cf = self._models[name].cf
+            groups.setdefault(id(cf), (cf, []))[1].append(name)
+        return list(groups.values())
+
+    def assert_no_retrace(self) -> None:
+        """Raise unless every compilation so far was an AOT bucket —
+        the zero-steady-state-retrace acceptance gate."""
+        bad, total = {}, 0
+        for cf, names in self._cf_groups():
+            if cf.retraces:
+                bad["+".join(names)] = cf.offbucket_batch_sizes(
+                    self.buckets)
+                total += cf.retraces
+        if bad:
+            raise MXNetError(
+                "serve path retraced: %d compilation(s) beyond the AOT "
+                "bucket set %s — off-bucket batch sizes per model: %s"
+                % (total, self.buckets, bad))
+
+    def lint(self):
+        """The ``serve-shape-bucket`` pass over this server's observed
+        compilations (see ``docs/how_to/graph_lint.md``)."""
+        from .. import analysis
+        return analysis.lint_server(self)

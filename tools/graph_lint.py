@@ -79,6 +79,40 @@ def trainer_step_report():
     return report
 
 
+def serving_report():
+    """Lint the SERVE PATH: a minimal in-process ModelServer (the bench
+    MLP, a 2-bucket AOT set) driven through a few mixed-size requests,
+    then ``analysis.lint_server`` over its observed compilation log.
+    The checked-in baseline records ZERO findings — a warn showing up
+    here means a forward compiled for a batch size outside the bucket
+    set, i.e. the serve path's padding regressed
+    (docs/how_to/serving.md)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=16, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=4, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc1_weight": mx.nd.array(rng.randn(16, 8).astype("f")),
+            "fc1_bias": mx.nd.array(np.zeros(16, "f")),
+            "fc2_weight": mx.nd.array(rng.randn(4, 16).astype("f")),
+            "fc2_bias": mx.nd.array(np.zeros(4, "f"))}
+    srv = serving.ModelServer(buckets=[1, 2], max_wait_us=500)
+    srv.add_model("mlp", sym, args, {}, input_shapes={"data": (8,)})
+    with srv:
+        # exercise the hot path so the lint sees a REAL trace log: one
+        # single-example and one padded two-row cycle, both in-bucket
+        srv.predict(data=np.zeros((8,), "f"))
+        srv.predict(data=np.zeros((2, 8), "f"))
+        report = srv.lint()
+    report.model = "serving"
+    return report
+
+
 def _parse_shapes(specs):
     """--shape name=(1,224,224,3) pairs -> dict."""
     import ast
@@ -150,14 +184,18 @@ def main(argv=None):
                 model=name)
     else:
         targets = bench_targets()
-        names = args.model or sorted(targets) + ["trainer-step"]
+        names = args.model or sorted(targets) + ["trainer-step", "serving"]
         for name in names:
             if name == "trainer-step":
                 reports[name] = trainer_step_report()
                 continue
+            if name == "serving":
+                reports[name] = serving_report()
+                continue
             if name not in targets:
                 raise SystemExit("unknown bench model %r (have %s, "
-                                 "trainer-step)" % (name, sorted(targets)))
+                                 "trainer-step, serving)"
+                                 % (name, sorted(targets)))
             t = targets[name]
             reports[name] = analysis.lint_symbol(
                 t["sym"], shapes=t["shapes"], dtypes=t["dtypes"],

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Serving benchmark: Poisson load over the continuous-batching
+ModelServer vs the single-request Predictor loop.
+
+Two load modes over the same model:
+
+* **single-request baseline** — the pre-serving deploy path: one
+  ``Predictor``, requests served strictly one at a time.  Its
+  sustained rate is the *capacity* the load sweep is scaled against.
+* **open-loop Poisson sweep** — arrivals drawn from an exponential
+  inter-arrival distribution at several offered loads (fractions and
+  multiples of the baseline capacity), submitted to a
+  :class:`~mxnet_tpu.serving.ModelServer`; per-request latency is
+  measured submit→future-complete, i.e. queueing + batching + compute.
+  Open loop means arrivals do NOT slow down when the server falls
+  behind — the honest way to show saturation (a closed loop would
+  self-throttle and flatter the p99).
+
+Request row counts are drawn from a mixed set (default 1/2/4), so the
+sweep also exercises the bucket padding: the run asserts **zero
+steady-state retraces** across the mixed shapes and reports the
+batch-occupancy histogram.
+
+A fault-injection pass (``MXTPU_FAULTS`` DSL, ``faults.py``) rides at
+the end: one poisoned and a few slow requests inside a burst, showing
+graceful degradation — the poisoned future fails alone, the slow
+requests stretch only their own cycles.
+
+``--out INFER_BENCH.json`` merges a ``serving`` section into the
+artifact (field definitions: docs/how_to/perf.md "Serving");
+``bench.py`` embeds the quick sweep via :func:`serving_probe`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+# ----------------------------------------------------------------------
+def build_model(network="mlp", seed=0):
+    """(symbol, arg_params, aux_params, per-example input shape)."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    if network == "mlp":
+        # serving-shaped MLP: big enough that batching matters, small
+        # enough that the CPU tier sweeps in seconds
+        data = mx.sym.Variable("data")
+        net = mx.symbol.FullyConnected(data, num_hidden=256, name="fc1")
+        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.FullyConnected(net, num_hidden=256, name="fc2")
+        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.FullyConnected(net, num_hidden=16, name="fc3")
+        sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+        example = (64,)
+        args = {
+            "fc1_weight": mx.nd.array(
+                (rng.randn(256, 64) / 8).astype("f")),
+            "fc1_bias": mx.nd.array(np.zeros(256, "f")),
+            "fc2_weight": mx.nd.array(
+                (rng.randn(256, 256) / 16).astype("f")),
+            "fc2_bias": mx.nd.array(np.zeros(256, "f")),
+            "fc3_weight": mx.nd.array(
+                (rng.randn(16, 256) / 16).astype("f")),
+            "fc3_bias": mx.nd.array(np.zeros(16, "f")),
+        }
+        return sym, args, {}, example
+    if network == "resnet-50":
+        from mxnet_tpu import models
+        sym = models.get_symbol("resnet-50", num_classes=1000,
+                                layout="NHWC")
+        example = (224, 224, 3)
+        # Xavier-init through a throwaway CPU module
+        import mxnet_tpu as mx
+        mod = mx.mod.Module(symbol=sym, context=mx.cpu())
+        mod.bind(for_training=False,
+                 data_shapes=[mx.io.DataDesc("data", (1,) + example)])
+        mod.init_params(initializer=mx.init.Xavier(magnitude=2.0))
+        arg_p, aux_p = mod.get_params()
+        return sym, arg_p, aux_p, example
+    raise SystemExit("unknown network %r (mlp, resnet-50)" % network)
+
+
+def single_request_baseline(sym, args, aux, example, n=300, seed=1):
+    """The pre-serving path: one Predictor, one request at a time.
+    Returns sustained rate + latency percentiles."""
+    import tempfile
+    import mxnet_tpu as mx
+    from mxnet_tpu.predictor import Predictor
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "m.params")
+        blob = {"arg:" + k: v for k, v in args.items()}
+        blob.update({"aux:" + k: v for k, v in aux.items()})
+        mx.nd.save(path, blob)
+        with open(path, "rb") as f:
+            param_bytes = f.read()
+    p = Predictor(sym.tojson(), param_bytes, {"data": (1,) + example})
+    rng = np.random.RandomState(seed)
+    x = rng.randn(1, *example).astype("f")
+    for _ in range(5):                         # compile + warm
+        p.predict(data=x)
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(n):
+        t1 = time.perf_counter()
+        p.predict(data=x)
+        lat.append(time.perf_counter() - t1)
+    elapsed = time.perf_counter() - t0
+    lat_ms = np.sort(np.asarray(lat)) * 1e3
+    return {
+        "requests": n,
+        "rps": round(n / elapsed, 1),
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+    }
+
+
+# ----------------------------------------------------------------------
+def _mixed_payloads(example, rows_mix, count, seed):
+    rng = np.random.RandomState(seed)
+    sizes = rng.choice(rows_mix, size=count)
+    return [rng.randn(int(s), *example).astype("f") for s in sizes]
+
+
+def poisson_run(server, payloads, rate_rps, model=None, seed=2):
+    """Open-loop Poisson arrivals at ``rate_rps`` requests/s: the
+    arrival schedule is fixed up front and honored regardless of how
+    far behind the server falls."""
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
+                                         size=len(payloads)))
+    futures = [None] * len(payloads)
+    t0 = time.perf_counter()
+    i = 0
+    while i < len(payloads):
+        now = time.perf_counter() - t0
+        while i < len(payloads) and arrivals[i] <= now:
+            futures[i] = server.submit(data=payloads[i], model=model)
+            i += 1
+        if i < len(payloads):
+            time.sleep(min(0.002, max(0.0, arrivals[i]
+                                      - (time.perf_counter() - t0))))
+    ok, failed, lat = 0, 0, []
+    for f in futures:
+        try:
+            f.result(timeout=60)
+            ok += 1
+            lat.append(f.latency_s)
+        except Exception:                          # noqa: BLE001
+            failed += 1
+    elapsed = time.perf_counter() - t0
+    rows = int(sum(p.shape[0] for p in payloads))
+    out = {
+        "offered_rps": round(rate_rps, 1),
+        "requests": len(payloads),
+        "completed": ok,
+        "failed": failed,
+        "achieved_rps": round(ok / elapsed, 1),
+        "achieved_rows_per_sec": round(rows / elapsed, 1),
+    }
+    if lat:
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        out.update({
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+            "max_ms": round(float(lat_ms[-1]), 3),
+        })
+    return out
+
+
+def fault_demo(server, example, model=None, n=12, seed=3):
+    """One poisoned + two slow requests inside a burst: the poisoned
+    future fails ALONE, everything else completes (docs/how_to/
+    resilience.md meets docs/how_to/serving.md)."""
+    from mxnet_tpu import faults
+    rng = np.random.RandomState(seed)
+    base_rid = server.stats()["requests"]
+    spec = ("poison_request@request=%d;slow_request@request=%d:count=2"
+            % (base_rid + 3, base_rid + 5))
+    with faults.injected(spec):
+        futs = [server.submit(data=rng.randn(1, *example).astype("f"),
+                              model=model) for _ in range(n)]
+        poisoned = sum(1 for f in futs if f.exception(timeout=60)
+                       is not None)
+    lat_ms = sorted((f.latency_s or 0) * 1e3 for f in futs)
+    return {"injected": spec, "requests": n, "failed": poisoned,
+            "completed": n - poisoned,
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3)}
+
+
+# ----------------------------------------------------------------------
+def serving_probe(network="mlp", quick=True, buckets=None,
+                  rows_mix=(1, 2, 4), load_factors=None, seed=0):
+    """The full sweep; returns the INFER_BENCH ``serving`` section."""
+    from mxnet_tpu import serving
+
+    sym, args, aux, example = build_model(network, seed)
+    n_base = 150 if quick else 400
+    per_load = 250 if quick else 1000
+    load_factors = list(load_factors
+                        or ((0.5, 1.0, 2.0) if quick
+                            else (0.25, 0.5, 1.0, 2.0, 4.0)))
+
+    base = single_request_baseline(sym, args, aux, example, n=n_base)
+    cap = base["rps"]
+
+    server = serving.ModelServer(buckets=buckets)
+    server.add_model("m", sym, args, aux,
+                     input_shapes={"data": example})
+    t0 = time.perf_counter()
+    server.start()
+    aot_s = time.perf_counter() - t0
+
+    loads = []
+    with server:
+        for f in load_factors:
+            payloads = _mixed_payloads(example, rows_mix, per_load,
+                                       seed + int(f * 100))
+            run = poisson_run(server, payloads, rate_rps=max(1.0, f * cap))
+            run["load_factor"] = f
+            loads.append(run)
+        server.assert_no_retrace()     # mixed shapes, zero retraces
+        st = server.stats()
+        demo = fault_demo(server, example)
+    return {
+        "network": network,
+        "buckets": st["buckets"],
+        "request_rows_mix": list(int(r) for r in rows_mix),
+        "aot_compiles": st["aot_compiles"],
+        "aot_compile_s": round(aot_s, 2),
+        "retraces": st["retraces"],
+        "single_request": base,
+        "loads": loads,
+        "occupancy": st["occupancy"],
+        "padding_frac": st["padding_frac"],
+        "batched_ge_single": all(
+            r["achieved_rps"] >= min(r["offered_rps"], cap) * 0.95
+            for r in loads),
+        "fault_demo": demo,
+    }
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--network", default="mlp",
+                    help="mlp (CPU-fast) or resnet-50")
+    ap.add_argument("--quick", action="store_true",
+                    help="bounded sweep (the bench.py probe)")
+    ap.add_argument("--buckets", default=None,
+                    help="comma batch buckets (default MXTPU_SERVE_BUCKETS"
+                         " or 1,4,8,16,32)")
+    ap.add_argument("--rows-mix", default="1,2,4",
+                    help="comma request row counts to mix")
+    ap.add_argument("--out", default=None,
+                    help="merge a 'serving' section into this "
+                         "INFER_BENCH.json artifact")
+    args = ap.parse_args(argv)
+
+    buckets = [int(b) for b in args.buckets.split(",")] \
+        if args.buckets else None
+    section = serving_probe(
+        network=args.network, quick=args.quick, buckets=buckets,
+        rows_mix=tuple(int(r) for r in args.rows_mix.split(",")))
+    import jax
+    section["device"] = "%s (%s)" % (jax.devices()[0].device_kind,
+                                     jax.default_backend())
+    print(json.dumps(section, indent=1))
+    if args.out:
+        artifact = {}
+        if os.path.exists(args.out):
+            with open(args.out) as f:
+                artifact = json.load(f)
+        artifact["serving"] = section
+        with open(args.out, "w") as f:
+            json.dump(artifact, f, indent=1)
+            f.write("\n")
+        print("wrote serving section -> %s" % args.out, file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
