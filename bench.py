@@ -380,6 +380,45 @@ def _zero_ab(mx, n_steps=4):
     return {"n_devices": len(devices), "steps": n_steps, "rows": rows}
 
 
+def _elastic_drill(timeout=420):
+    """2-process CPU elastic recovery drill (docs/how_to/multi_host.md
+    "Elastic training"): the launcher's ``--local-elastic`` runs
+    ``tests/nightly/elastic_train.py`` with a ``host_dead`` fault on
+    rank 1 — heartbeat detection, membership shrink 2->1, relaunch,
+    checkpoint auto-resume — and reports ``elastic_recovery_s``: wall
+    time from the monitor PUBLISHING the shrunk epoch (detect) to the
+    resumed run completing its first step."""
+    import re
+    import shutil
+    import subprocess
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix="mxtpu-elastic-bench-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_FAULTS"] = "host_dead@step=11:rank=1"
+    env.pop("MXTPU_COORDINATOR", None)
+    env.pop("MXTPU_ELASTIC_DIR", None)
+    env.pop("MXTPU_HEARTBEAT_DIR", None)
+    try:
+        res = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "launch.py"),
+             "--local-elastic", "2", "--",
+             sys.executable,
+             os.path.join(root, "tests", "nightly", "elastic_train.py"),
+             workdir],
+            env=env, cwd=root, capture_output=True, text=True,
+            timeout=timeout)
+        m = re.search(r"ELASTIC_RECOVERY_S=([0-9.]+)", res.stdout)
+        if res.returncode != 0 or m is None:
+            raise RuntimeError(
+                "elastic drill failed (rc=%d): %s"
+                % (res.returncode, (res.stdout + res.stderr)[-800:]))
+        return round(float(m.group(1)), 2)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     # fuse the Module step on every backend (the default for tpu contexts)
     os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
@@ -597,6 +636,16 @@ def main():
             line["serving"] = serving_probe(quick=True)
         except Exception as e:                      # noqa: BLE001
             line["serving_error"] = str(e)
+
+    # --- elastic recovery drill (docs/how_to/multi_host.md "Elastic
+    # training"): detect->resumed-first-step wall time from a real
+    # 2-process kill-shrink-resume on CPU.  Subprocess-heavy (~1 min);
+    # MXTPU_BENCH_ELASTIC=0 skips.
+    if os.environ.get("MXTPU_BENCH_ELASTIC", "1") != "0":
+        try:
+            line["elastic_recovery_s"] = _elastic_drill()
+        except Exception as e:                      # noqa: BLE001
+            line["elastic_error"] = str(e)
 
     # --- streaming pipeline (datasets beyond HBM), wire-paced
     if on_tpu and os.environ.get("MXTPU_BENCH_STREAM_PROBE", "1") != "0":

@@ -24,17 +24,67 @@ if _os.environ.get("MXTPU_COORDINATOR"):
             "contract (tools/launch.py) requires all three MXTPU_* vars"
             % (" and ".join(_missing),
                "is" if len(_missing) == 1 else "are"))
-    try:
-        _jax.distributed.initialize(
-            coordinator_address=_os.environ["MXTPU_COORDINATOR"],
-            num_processes=int(_os.environ["MXTPU_NUM_PROCESSES"]),
-            process_id=int(_os.environ["MXTPU_PROCESS_ID"]))
-    except RuntimeError as _e:
-        # tolerate a host program that already initialized jax.distributed
-        # (jax wording varies across versions)
-        if "already initialized" not in str(_e) and \
-                "only be called once" not in str(_e):
-            raise
+    def _join_coordination():
+        # bounded attempts with backoff (the retry_io shape, inlined —
+        # the package is mid-import) plus an optional hard timeout per
+        # attempt: a flapping coordinator or a half-restarted peer must
+        # surface as a clean failure the restart orchestration can act
+        # on, never as survivors wedged inside the join forever
+        # (docs/how_to/multi_host.md "Elastic training")
+        _kw = {}
+        _t = float(_os.environ.get("MXTPU_INIT_TIMEOUT_S", "0") or 0)
+        if _t > 0:
+            import inspect as _inspect
+            try:
+                if "initialization_timeout" in _inspect.signature(
+                        _jax.distributed.initialize).parameters:
+                    # int, not float: the xla_extension binding under
+                    # this kwarg rejects float seconds with a TypeError
+                    _kw["initialization_timeout"] = max(1, int(_t))
+            except (TypeError, ValueError):
+                pass
+        _attempts = max(1, int(_os.environ.get("MXTPU_INIT_ATTEMPTS",
+                                               "3")))
+        _delay = 0.5
+        _failures = _stale = 0
+        while True:
+            try:
+                _jax.distributed.initialize(
+                    coordinator_address=_os.environ["MXTPU_COORDINATOR"],
+                    num_processes=int(_os.environ["MXTPU_NUM_PROCESSES"]),
+                    process_id=int(_os.environ["MXTPU_PROCESS_ID"]),
+                    **_kw)
+                return
+            except RuntimeError as _e:
+                if "already initialized" in str(_e) or \
+                        "only be called once" in str(_e):
+                    if _failures == 0:
+                        # a host program already joined before us —
+                        # benign (jax wording varies across versions)
+                        return
+                    # NOT benign after our own failed attempt: jax
+                    # assigns its global client BEFORE connecting, so
+                    # the failure left half-initialized, never-
+                    # connected state behind — tear it down and retry
+                    # for real (without burning a retry on, or ever
+                    # re-raising, this leftover error)
+                    _stale += 1
+                    if _stale > _attempts:
+                        raise      # shutdown can't clear it: give up
+                    try:
+                        _jax.distributed.shutdown()
+                    except Exception:      # noqa: BLE001
+                        pass
+                    continue
+                _failures += 1
+                if _failures >= _attempts:
+                    raise
+                import time as _time
+                _time.sleep(_delay)
+                _delay *= 2.0
+
+    _join_coordination()
+    del _join_coordination
 
 from . import base
 from .base import (Context, MXNetError, cpu, gpu, tpu, current_context)

@@ -1,0 +1,473 @@
+"""Elastic data-parallel membership: dead-host detection, shrink, resume.
+
+The reference's scaling path is the ps-lite parameter server, whose
+liveness story (heartbeats -> ``get_num_dead_node`` -> restart-aware
+barriers) treats worker death as detectable but leaves recovery to the
+operator.  Here worker failure is a FIRST-CLASS, recoverable event, the
+way the TensorFlow architecture frames it (PAPERS.md): the job carries a
+**membership epoch** — an integer plus the list of live ranks — layered
+on the ``health.py`` heartbeat transports, and a dead host triggers a
+deterministic shrink-and-resume instead of a hung collective:
+
+1. every rank stamps liveness (``health.Heartbeat``, sequence-numbered
+   and clock-skew tolerant) and, per step, a **collective-entry
+   barrier** stamp saying "I commit to step N";
+2. a deterministic monitor (the lowest surviving rank) detects lapsed
+   ranks via ``health.dead_nodes`` and publishes epoch ``k+1`` with the
+   shrunk world to the shared membership record (atomic tmp+rename,
+   fsync'd — the same commit recipe as the checkpoint manifests);
+3. every survivor observes the new epoch at the next batch boundary
+   (:class:`ElasticShrink`), exits its step loop, re-initializes
+   ``jax.distributed`` + a shrunk process-spanning mesh (the launcher's
+   ``--local-elastic`` relaunches survivors; on a pod the operator's
+   supervisor does), and auto-resumes from the latest CRC-manifested
+   checkpoint — ``CheckpointManager`` restores onto whatever layout the
+   shrunk trainer plans, so ZeRO-1 shards simply re-plan for the new
+   world size;
+4. a rank that was declared dead but is actually alive (the heartbeat-
+   stall split brain) observes that the epoch moved on WITHOUT it
+   (:class:`ElasticRevoked`) and exits cleanly instead of corrupting
+   the checkpoint directory.
+
+The pre-step barrier is what prevents the classic failure mode — a dead
+host wedging every survivor inside an XLA collective: no rank enters the
+step program until every member has committed to it, and the bounded
+wait degrades into detection instead of a hang.  (A host dying INSIDE a
+collective is still fail-stop; the barrier narrows the window to the
+step's own duration, and the coordination-service timeout covers the
+rest.)
+
+Wiring: ``Module.fit(..., elastic=ElasticCoordinator(...))`` guards
+every batch; ``tools/launch.py --local-elastic N`` provides the
+relaunch orchestration and measures ``elastic_recovery_s``
+(detect -> resumed-first-step).  See docs/how_to/multi_host.md
+"Elastic training".
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import List, Optional
+
+from .base import MXNetError
+from . import faults as _faults
+from . import health as _health
+from .parallel.collectives import _process_count, _process_index
+from .resilience import retry_io
+
+__all__ = ["ElasticCoordinator", "ElasticShrink", "ElasticRevoked",
+           "Membership", "read_membership", "membership_path",
+           "SHRINK_EXIT_CODE"]
+
+# a worker that exits because the membership shrank (not because IT
+# failed) uses this code so the launcher can tell "relaunch the
+# survivors" from "the job is broken"
+SHRINK_EXIT_CODE = 96
+
+_MEMBERSHIP_FILE = "membership.json"
+
+# measurement tolerance when deciding whether a heartbeat stamp
+# predates this coordinator's start (previous incarnation) or was
+# written during it (a real lapse)
+_INCARNATION_SLACK_S = 1.0
+
+
+def membership_path(directory: str) -> str:
+    return os.path.join(directory, _MEMBERSHIP_FILE)
+
+
+class Membership:
+    """One membership epoch: the integer epoch, the live ranks, and the
+    publish wallclock (the ``detect`` end of ``elastic_recovery_s``)."""
+
+    __slots__ = ("epoch", "world", "num_workers", "wallclock", "dead")
+
+    def __init__(self, epoch: int, world: List[int], num_workers: int,
+                 wallclock: Optional[float] = None,
+                 dead: Optional[List[int]] = None):
+        self.epoch = int(epoch)
+        self.world = sorted(int(r) for r in world)
+        self.num_workers = int(num_workers)
+        self.wallclock = wallclock
+        self.dead = sorted(int(r) for r in (dead or ()))
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch, "world": self.world,
+                "num_workers": self.num_workers,
+                "wallclock": self.wallclock, "dead": self.dead}
+
+    def __repr__(self):
+        return "Membership(epoch=%d, world=%s)" % (self.epoch, self.world)
+
+
+def read_membership(directory: str, num_workers: int) -> Membership:
+    """The current membership record; epoch 1 over all ranks when none
+    has been published (the implicit founding epoch)."""
+    try:
+        with open(membership_path(directory)) as f:
+            raw = json.load(f)
+        return Membership(raw["epoch"], raw["world"],
+                          raw.get("num_workers", num_workers),
+                          raw.get("wallclock"), raw.get("dead"))
+    except (OSError, ValueError, KeyError):
+        # the record is only ever committed via atomic rename, so
+        # "unreadable" means "never published", not "torn"
+        return Membership(1, list(range(num_workers)), num_workers)
+
+
+def _write_membership(directory: str, mem: Membership) -> None:
+    """Atomic, fsync'd commit of the membership record — the same
+    tmp+rename recipe as the checkpoint manifests (``model._commit_file``
+    is not reused verbatim: a fixed ``.tmp`` name would let two racing
+    publishers tear each other; the pid-suffixed tmp cannot)."""
+    path = membership_path(directory)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(mem.to_dict(), f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+class ElasticShrink(Exception):
+    """The membership epoch moved: exit the step loop at this batch
+    boundary, tear down, and let the orchestrator relaunch the shrunk
+    world (which auto-resumes from the newest intact checkpoint).
+    Deliberately NOT an MXNetError: generic training-error recovery
+    must not swallow a membership transition."""
+
+    def __init__(self, membership: Membership, dead=()):
+        self.membership = membership
+        self.dead = sorted(dead)
+        super().__init__(
+            "membership epoch %d: world=%s dead=%s — exit and resume "
+            "under the new world" % (membership.epoch, membership.world,
+                                     self.dead))
+
+
+class ElasticRevoked(ElasticShrink):
+    """THIS rank was declared dead and shrunk out (lapsed heartbeat —
+    possibly a stalled stamper on a live process, the split brain).  It
+    must exit cleanly without touching the checkpoint line: the
+    surviving world has already moved on."""
+
+
+class ElasticCoordinator:
+    """Per-rank membership agent: stamps liveness, guards every step
+    entry, detects lapsed peers, publishes/observes membership epochs.
+
+    ``guard()`` is the one call sites need — once per step, BEFORE the
+    step's collectives::
+
+        coord = ElasticCoordinator()
+        try:
+            mod.fit(train, elastic=coord, checkpoint=prefix, resume=True,
+                    ...)
+        except elastic.ElasticShrink:
+            sys.exit(elastic.SHRINK_EXIT_CODE)   # orchestrator relaunches
+
+    Deterministic monitor: the LOWEST surviving rank publishes the new
+    epoch; everyone else only reads.  A lapsed rank is removed exactly
+    once per epoch — the scan intersects with the CURRENT world, so a
+    still-stale stamp of an already-removed rank can never double-
+    shrink, and a slow rejoiner finds itself revoked instead of racing
+    the survivors.
+
+    Env defaults (each also a constructor argument):
+
+    * ``MXTPU_ELASTIC_DIR`` — shared membership/barrier directory
+      (defaults to ``MXTPU_HEARTBEAT_DIR``).
+    * ``MXTPU_ELASTIC_HB_TIMEOUT_S`` (10) — heartbeat staleness that
+      declares a rank dead.
+    * ``MXTPU_ELASTIC_STEP_TIMEOUT_S`` (60) — bounded pre-step barrier
+      wait for the first attempt; each retry doubles it (the retry_io
+      backoff shape: worst case ``(2**attempts - 1) * step_timeout``
+      before the wedged error).
+    * ``MXTPU_ELASTIC_CHECK_S`` (2) — throttle on the monitor scan.
+    * ``MXTPU_ELASTIC_JOIN_GRACE_S`` (120) — never declare a rank that
+      has NOT YET stamped dead before this much time has passed since
+      this coordinator started (ranks compile/initialize at different
+      speeds; a rank that HAS stamped and lapsed is dead regardless).
+    """
+
+    def __init__(self, rank: Optional[int] = None,
+                 num_workers: Optional[int] = None,
+                 directory: Optional[str] = None,
+                 heartbeat: Optional["_health.Heartbeat"] = None,
+                 hb_timeout: Optional[float] = None,
+                 step_timeout: Optional[float] = None,
+                 check_interval: Optional[float] = None,
+                 join_grace: Optional[float] = None,
+                 barrier_attempts: int = 3,
+                 poll_interval: float = 0.02,
+                 logger=None):
+        def _envf(value, env, default):
+            if value is not None:
+                return float(value)
+            return float(os.environ.get(env, "") or default)
+
+        if rank is None:
+            rank = int(os.environ.get("MXTPU_PROCESS_ID", "") or
+                       _process_index())
+        if num_workers is None:
+            num_workers = int(os.environ.get("MXTPU_NUM_PROCESSES", "") or
+                              _process_count())
+        self.rank = int(rank)
+        self.num_workers = int(num_workers)
+        self.directory = directory or os.environ.get("MXTPU_ELASTIC_DIR") \
+            or _health.heartbeat_dir()
+        if not self.directory:
+            raise MXNetError(
+                "ElasticCoordinator needs a shared directory: pass "
+                "directory= or set MXTPU_ELASTIC_DIR / "
+                "MXTPU_HEARTBEAT_DIR (tools/launch.py --local-elastic "
+                "sets both)")
+        os.makedirs(self.directory, exist_ok=True)
+        self.hb_timeout = _envf(hb_timeout, "MXTPU_ELASTIC_HB_TIMEOUT_S",
+                                10.0)
+        self.step_timeout = _envf(step_timeout,
+                                  "MXTPU_ELASTIC_STEP_TIMEOUT_S", 60.0)
+        self.check_interval = _envf(check_interval, "MXTPU_ELASTIC_CHECK_S",
+                                    2.0)
+        self.join_grace = _envf(join_grace, "MXTPU_ELASTIC_JOIN_GRACE_S",
+                                120.0)
+        self.barrier_attempts = max(1, int(barrier_attempts))
+        self.poll_interval = float(poll_interval)
+        self.logger = logger or logging.getLogger("mxtpu.elastic")
+        self._own_hb = heartbeat is None
+        self._hb = heartbeat if heartbeat is not None else _health.Heartbeat(
+            self.rank, directory=self.directory,
+            interval=min(_health._DEFAULT_INTERVAL, self.hb_timeout / 4.0))
+        self._start_mono = time.monotonic()
+        self._last_scan = 0.0
+        self._guards = 0
+        self._mem_cache = None
+        # new-incarnation adoption: a record whose world SIZE differs
+        # from ours is a previous incarnation's (a supervisor relaunched
+        # the shrunk world into the same shared dir with new contiguous
+        # ranks) — membership() synthesizes the founding epoch over the
+        # env world instead of instantly revoking renumbered ranks;
+        # rank 0 persists it so external readers converge
+        disk = read_membership(self.directory, self.num_workers)
+        if disk.num_workers != self.num_workers and self.rank == 0:
+            founding = self.membership()
+            founding.wallclock = time.time()
+            retry_io(lambda: _write_membership(self.directory, founding),
+                     what="membership founding write", logger=self.logger)
+        self._epoch = self.membership().epoch
+
+    # ------------------------------------------------------------ state
+    def membership(self) -> Membership:
+        mem = read_membership(self.directory, self.num_workers)
+        if mem.num_workers != self.num_workers:
+            # previous incarnation's record (see __init__): every rank
+            # of the new incarnation deterministically computes the
+            # same founding epoch from it
+            mem = Membership(mem.epoch + 1, list(range(self.num_workers)),
+                             self.num_workers)
+        return mem
+
+    def _barrier_path(self, rank: int) -> str:
+        return os.path.join(self.directory, "step-%d" % rank)
+
+    def _stamp_step(self, step: int) -> None:
+        # "<epoch> <step>": epoch-scoped so a stale stamp from a
+        # previous incarnation sharing this directory can never satisfy
+        # (and silently disarm) the new incarnation's barrier
+        tmp = "%s.tmp" % self._barrier_path(self.rank)
+        with open(tmp, "w") as f:
+            f.write("%d %d\n" % (self._epoch, step))
+        os.replace(tmp, self._barrier_path(self.rank))
+
+    def _read_step(self, rank: int) -> int:
+        try:
+            with open(self._barrier_path(rank)) as f:
+                epoch, step = f.read().split()[:2]
+            return int(step) if int(epoch) == self._epoch else -1
+        except (OSError, ValueError, IndexError):
+            return -1
+
+    # ------------------------------------------------------------ guard
+    def guard(self, step: Optional[int] = None) -> Membership:
+        """The collective-entry guard: call once per step, before the
+        step's collectives run.  Stamps "this rank commits to ``step``",
+        verifies the membership epoch, runs the (throttled) monitor
+        scan, and waits — bounded — until every member has committed to
+        the same step.  Raises :class:`ElasticShrink` (the world
+        shrank: exit and resume) or :class:`ElasticRevoked` (YOU were
+        shrunk out: exit, touch nothing)."""
+        self._guards += 1
+        step = self._guards if step is None else int(step)
+        if _faults.hit("host_dead", step=step, rank=self.rank):
+            # the injected whole-host death: SIGKILL-faithful, and
+            # BEFORE the barrier stamp — peers must never believe this
+            # rank committed to the step
+            os._exit(137)
+        now = time.monotonic()
+        if self._mem_cache is None \
+                or now - self._last_scan >= self.check_interval:
+            # membership read and liveness scan share the throttle: on
+            # fast steps an unconditional per-step json read of the
+            # shared record would be the same metadata storm the
+            # barrier loop avoids; epoch observation lag stays bounded
+            # by one scan period
+            self._last_scan = now
+            self._mem_cache = self._check_membership()
+            self._scan(self._mem_cache)
+        mem = self._mem_cache
+        if len(mem.world) > 1:
+            self._barrier(step, mem)
+        return mem
+
+    def _check_membership(self) -> Membership:
+        mem = self.membership()
+        if self.rank not in mem.world:
+            self.logger.warning(
+                "rank %d: revoked by membership epoch %d (world=%s) — "
+                "exiting without touching the checkpoint line",
+                self.rank, mem.epoch, mem.world)
+            raise ElasticRevoked(mem, dead=[self.rank])
+        if mem.epoch != self._epoch:
+            raise ElasticShrink(mem, dead=mem.dead)
+        return mem
+
+    # ---------------------------------------------------------- monitor
+    def _lapsed(self, mem: Membership) -> List[int]:
+        """Members (other than self) whose liveness has lapsed.  A rank
+        that has never stamped is only "dead" once ``join_grace`` has
+        passed — slow starters are not failures; a rank that HAS
+        stamped and went stale is dead on ``hb_timeout`` alone (the
+        sequence-progress scan in health.py makes that judgment
+        clock-skew tolerant)."""
+        evidence = _health.rank_evidence(self.num_workers,
+                                         directory=self.directory)
+        if not evidence:
+            return []
+        elapsed = time.monotonic() - self._start_mono
+        grace_left = elapsed < self.join_grace
+        out = []
+        for rank in mem.world:
+            if rank == self.rank:
+                continue
+            age = evidence.get(rank)
+            if age is not None and age <= self.hb_timeout:
+                continue                       # fresh
+            if grace_left and (age is None
+                               or age > elapsed + _INCARNATION_SLACK_S):
+                # no stamp from THIS incarnation yet: either the rank
+                # has never stamped, or the only evidence predates this
+                # coordinator's start (a previous incarnation's stale
+                # file in a shared dir) — a slow starter, not a lapse.
+                # The slack is small measurement tolerance, NOT
+                # hb_timeout: a stamp merely hb_timeout older than our
+                # start is still a pre-incarnation stamp, and counting
+                # it would spuriously shrink a slow starter.
+                continue
+            out.append(rank)
+        return out
+
+    def _scan(self, mem: Membership) -> None:
+        """One monitor pass: on lapsed members, the lowest surviving
+        rank publishes the shrunk epoch and raises
+        :class:`ElasticShrink`; every OTHER survivor keeps its
+        heartbeat visible and waits (bounded) to observe the published
+        epoch — exiting on a locally computed, never-published
+        membership would stop this rank's stamps before a busy
+        publisher (mid checkpoint write) runs its own scan, which
+        would then find this healthy rank lapsed too and over-shrink
+        the job."""
+        lapsed = self._lapsed(mem)
+        if not lapsed:
+            return
+        survivors = [r for r in mem.world if r not in lapsed]
+        if self.rank == min(survivors):
+            new = Membership(mem.epoch + 1, survivors, self.num_workers,
+                             wallclock=time.time(), dead=lapsed)
+            self._publish(mem, new)
+            raise ElasticShrink(new, dead=lapsed)
+        deadline = time.monotonic() + self.step_timeout
+        while time.monotonic() < deadline:
+            now = time.monotonic()
+            if now - self._last_scan >= self.check_interval:
+                # same throttle as the barrier loop: the wait must not
+                # itself become a membership/heartbeat metadata storm
+                self._last_scan = now
+                self._check_membership()   # raises once the epoch moves
+                lapsed = self._lapsed(mem)
+                if not lapsed:
+                    return                 # a flap resolved: no shrink
+                survivors = [r for r in mem.world if r not in lapsed]
+                if self.rank == min(survivors):
+                    # the expected publisher lapsed too: the duty falls
+                    # to this rank
+                    new = Membership(mem.epoch + 1, survivors,
+                                     self.num_workers,
+                                     wallclock=time.time(), dead=lapsed)
+                    self._publish(mem, new)
+                    raise ElasticShrink(new, dead=lapsed)
+            time.sleep(max(self.poll_interval, 0.05))
+        # publisher alive but silent through the whole bounded wait:
+        # exiting on the predicted membership beats wedging the job
+        raise ElasticShrink(
+            Membership(mem.epoch + 1, survivors, self.num_workers,
+                       wallclock=time.time(), dead=lapsed), dead=lapsed)
+
+    def _publish(self, prev: Membership, new: Membership) -> None:
+        def write():
+            cur = read_membership(self.directory, self.num_workers)
+            if cur.epoch > prev.epoch:
+                return      # a racing publisher already moved the epoch
+            _write_membership(self.directory, new)
+        retry_io(write, what="membership publish", logger=self.logger)
+        self.logger.warning(
+            "rank %d: published membership epoch %d — dead=%s, "
+            "surviving world=%s", self.rank, new.epoch, new.dead,
+            new.world)
+
+    # ---------------------------------------------------------- barrier
+    def _barrier(self, step: int, mem: Membership) -> None:
+        """Commit to ``step`` and wait (bounded) for every member's
+        commitment.  While waiting: watch the membership epoch (another
+        survivor may publish first) and run the throttled liveness scan
+        (a peer dying DURING the wait is detected in ~hb_timeout, not
+        step_timeout).  A timeout with every peer still heartbeat-fresh
+        is retried with backoff — ``barrier_attempts`` waits starting
+        at ``step_timeout`` and doubling, the retry_io shape — before
+        declaring the job wedged."""
+        self._stamp_step(step)
+        peers = [r for r in mem.world if r != self.rank]
+        for attempt in range(self.barrier_attempts):
+            deadline = time.monotonic() + self.step_timeout * (2 ** attempt)
+            while time.monotonic() < deadline:
+                waiting = [r for r in peers if self._read_step(r) < step]
+                if not waiting:
+                    return
+                now = time.monotonic()
+                if now - self._last_scan >= self.check_interval:
+                    # membership re-read and liveness scan share the
+                    # throttle: the tight loop below polls only the
+                    # peer step files, not the shared membership record
+                    # (50 json reads/s per rank on an NFS dir is a
+                    # metadata storm for no detection benefit)
+                    self._last_scan = now
+                    self._check_membership()
+                    self._scan(mem)
+                time.sleep(self.poll_interval)
+            # bounded wait expired: one unthrottled scan before retrying
+            self._scan(mem)
+            self.logger.warning(
+                "rank %d: step-%d barrier timed out (attempt %d/%d) but "
+                "every peer's heartbeat is fresh — backing off and "
+                "retrying", self.rank, step, attempt + 1,
+                self.barrier_attempts)
+        raise MXNetError(
+            "elastic step barrier wedged: ranks %s never committed to "
+            "step %d across %d bounded waits and their heartbeats are "
+            "fresh" % ([r for r in peers if self._read_step(r) < step],
+                       step, self.barrier_attempts))
+
+    def close(self) -> None:
+        if self._own_hb:
+            self._hb.stop()

@@ -13,9 +13,13 @@ Spec grammar (``MXTPU_FAULTS`` or :func:`configure`)::
     directive := kind "@" item (":" item)*
     item      := key "=" int | bare-word
 
-* ``kind`` names the fault (``nan_grad``, ``io_error``, ``crash``).
+* ``kind`` names the fault (``nan_grad``, ``io_error``, ``crash``,
+  ``host_dead``, ``hb_stall``, ...).
 * A ``key=int`` item is a threshold on a counter the injection site
-  reports (``step=3`` arms once the site's ``step`` reaches 3).
+  reports (``step=3`` arms once the site's ``step`` reaches 3) —
+  except ``rank``, which matches EXACTLY (a rank is an identity, not a
+  counter: ``host_dead@step=3:rank=1`` must kill rank 1, not every
+  rank >= 1).
 * A bare word must equal the site's ``site=`` context value
   (``crash@ckpt_write`` fires at the checkpoint-write site).
 * ``count=N`` fires the directive on its first N armed hits
@@ -35,6 +39,19 @@ spec is installed):
 * ``crash`` — ``model._atomic_save`` (``site=ckpt_write``, ``save=``)
   calls ``os._exit(137)`` AFTER the tmp write and BEFORE the rename:
   a SIGKILL-faithful torn checkpoint, no atexit hooks, no flushes.
+* ``host_dead`` — the elastic pre-step guard (``elastic.
+  ElasticCoordinator.guard``; also ``Trainer.step`` for non-elastic
+  runs): the targeted rank calls ``os._exit(137)`` at the step
+  boundary, BEFORE committing to the step barrier/collective — a whole
+  host dropping out of the job (``step=`` is the 1-based update
+  counter, ``rank=`` matches exactly).  Drives membership shrink +
+  checkpoint resume (``docs/how_to/multi_host.md`` "Elastic
+  training").
+* ``hb_stall`` — ``Heartbeat._beat`` (``site=hb_stamp``, ``beat=``,
+  ``rank=`` exact): the heartbeat thread freezes WITHOUT process death
+  — the split-brain case.  The rank keeps training while its stamps go
+  stale; peers (correctly) declare it dead and shrink; the stalled
+  rank must observe its own revocation and exit cleanly.
 * ``slow_request`` / ``poison_request`` — the serving layer
   (``serving/server.py``; ``request=`` is the server's 1-based request
   counter).  A slow request sleeps ``MXTPU_SERVE_SLOW_S`` during batch
@@ -61,6 +78,11 @@ __all__ = ["configure", "clear", "active", "hit", "maybe_crash",
            "fired", "injected", "InjectedCrash"]
 
 _ENV = "MXTPU_FAULTS"
+
+# condition keys that name an IDENTITY rather than a counter: matched
+# exactly, not as a >= threshold (killing "rank 1" must not also kill
+# rank 2)
+_EXACT_KEYS = frozenset(("rank",))
 
 
 class InjectedCrash(BaseException):
@@ -90,7 +112,12 @@ class _Directive:
                 return False
         for key, threshold in self.conds.items():
             val = ctx.get(key)
-            if val is None or int(val) < threshold:
+            if val is None:
+                return False
+            if key in _EXACT_KEYS:
+                if int(val) != threshold:
+                    return False
+            elif int(val) < threshold:
                 return False
         return True
 

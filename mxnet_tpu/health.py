@@ -6,7 +6,8 @@ The reference surfaces worker/server liveness through ps-lite heartbeats
 (``is_recovery``, ``kvstore_dist.h:39-44``).  The TPU build has no server
 role and XLA collectives are fail-stop, so recovery = detect + restart +
 reload checkpoint (SURVEY §5).  This module provides the detection half;
-``tools/launch.py --auto-restart`` provides the restart half.
+``tools/launch.py --auto-restart`` provides the whole-job restart half and
+``mxnet_tpu.elastic`` the shrink-in-place half.
 
 Two stamp transports, chosen per call:
 
@@ -20,6 +21,15 @@ Two stamp transports, chosen per call:
 
 Both are scanned by :func:`dead_nodes`; a rank is alive if EITHER stamp
 is fresh, so mixed configurations never produce false positives.
+
+Clock skew: every stamp carries a **monotonic sequence number** beside
+the wall-clock time (``"<time> <seq>"``).  Once a rank's sequence has
+been observed, liveness is judged by sequence PROGRESS against the
+scanner's own monotonic clock — a rank whose clock runs far behind is
+not declared dead on wall-clock age, and a rank whose clock runs ahead
+cannot stamp itself alive into the future.  First observations (and
+stamps without a sequence — the pre-seq format stays readable) fall back
+to wall-clock/mtime age.
 """
 from __future__ import annotations
 
@@ -28,11 +38,11 @@ import os
 import threading
 import time
 import weakref
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from . import faults as _faults
 
-__all__ = ["Heartbeat", "dead_nodes", "heartbeat_dir"]
+__all__ = ["Heartbeat", "dead_nodes", "rank_evidence", "heartbeat_dir"]
 
 _DEFAULT_INTERVAL = 1.0
 _KV_PREFIX = "mxtpu/hb/"
@@ -86,6 +96,7 @@ class Heartbeat:
         self._stop = threading.Event()
         self._thread = None
         self._beats = 0
+        self._stalled = False
         if self.directory:
             os.makedirs(self.directory, exist_ok=True)
         if self.directory or self._kv is not None:
@@ -104,12 +115,33 @@ class Heartbeat:
     def active(self) -> bool:
         return self._thread is not None
 
+    @property
+    def stalled(self) -> bool:
+        """True once an injected ``hb_stall`` fault froze the stamper
+        (the thread keeps running, the process keeps training — the
+        split-brain shape: this rank WILL be declared dead)."""
+        return self._stalled
+
     def _beat(self):
         self._beats += 1
+        if _faults.hit("hb_stall", site="hb_stamp", beat=self._beats,
+                       rank=self.rank):
+            # the split-brain fault: the stamper freezes but the process
+            # lives on — peers will (correctly, per the liveness
+            # contract) declare this rank dead; mxnet_tpu.elastic makes
+            # the declared-dead-but-alive rank exit cleanly when it
+            # observes its own revocation
+            self._stalled = True
+        if self._stalled:
+            return
         if _faults.hit("io_error", site="hb_stamp", beat=self._beats):
             raise OSError("injected io_error at heartbeat stamp %d"
                           % self._beats)
-        stamp = "%f" % time.time()
+        # "<wall-clock> <sequence>": the sequence side is what scanners
+        # on other hosts trust once they have seen it advance (clock-
+        # skew tolerance); the wall-clock side keeps pre-seq scanners
+        # and first observations working
+        stamp = "%f %d" % (time.time(), self._beats)
         if self.directory:
             with open(_stamp_path(self.directory, self.rank), "w") as f:
                 f.write(stamp + "\n")
@@ -131,12 +163,27 @@ class Heartbeat:
             self._thread = None
 
 
+def _parse_stamp(text: str):
+    """``(wall, seq)`` from stamp content; either side may be None."""
+    parts = text.split()
+    wall = seq = None
+    try:
+        wall = float(parts[0])
+    except (ValueError, IndexError):
+        pass
+    try:
+        seq = int(parts[1])
+    except (ValueError, IndexError):
+        pass
+    return wall, seq
+
+
 def _file_stamps(directory: str, num_workers: int) -> dict:
-    """Freshest evidence per rank from the stamp files.  A stamp caught
-    mid-write (empty, truncated float, interleaved garbage) or one that
-    cannot be opened still counts through its mtime — a rank must never
-    be declared dead because the SCANNER hit a torn read; only a stamp
-    with no readable evidence at all is skipped."""
+    """Per-rank ``(wall, seq)`` evidence from the stamp files.  A stamp
+    caught mid-write (empty, truncated float, interleaved garbage) or
+    one that cannot be opened still counts through its mtime — a rank
+    must never be declared dead because the SCANNER hit a torn read;
+    only a stamp with no readable evidence at all is skipped."""
     out = {}
     for rank in range(num_workers):
         path = _stamp_path(directory, rank)
@@ -145,15 +192,17 @@ def _file_stamps(directory: str, num_workers: int) -> dict:
             mtime = os.path.getmtime(path)
         except OSError:
             pass
-        written = None
+        written = seq = None
         try:
             with open(path) as f:
-                written = float(f.read().split()[0])
-        except (OSError, ValueError, IndexError):
-            pass               # unreadable or partially written
-        candidates = [t for t in (mtime, written) if t is not None]
-        if candidates:
-            out[rank] = max(candidates)
+                written, seq = _parse_stamp(f.read())
+        except (OSError, ValueError):
+            pass   # unreadable, partially written, or non-UTF-8 garbage
+                   # (UnicodeDecodeError is a ValueError): mtime still
+                   # counts — the scanner must never die on a torn read
+        walls = [t for t in (mtime, written) if t is not None]
+        if walls or seq is not None:
+            out[rank] = (max(walls) if walls else None, seq)
     return out
 
 
@@ -165,31 +214,103 @@ def _kv_stamps(client) -> dict:
         return out
     for key, value in rows:
         try:
-            out[int(key.rsplit("/", 1)[-1])] = float(value)
+            rank = int(key.rsplit("/", 1)[-1])
         except ValueError:
-            pass
+            continue
+        wall, seq = _parse_stamp(value)
+        if wall is not None or seq is not None:
+            out[rank] = (wall, seq)
+    return out
+
+
+# sequence-progress memory: (transport key, rank) -> (last seq seen,
+# scanner-monotonic time when that value was FIRST seen, wall-clock age
+# of the stamp AT that first sight — the baseline that keeps a stale
+# file discovered mid-life from reading as "fresh for one timeout").
+# Guarded by a lock: dead_nodes may be called from monitor threads.
+_seq_lock = threading.Lock()
+_seq_track: Dict[tuple, tuple] = {}
+
+
+def _reset_seq_cache():
+    """Forget all sequence-progress history (tests)."""
+    with _seq_lock:
+        _seq_track.clear()
+
+
+def _evidence_age(key, rank, wall, seq, now_wall, now_mono):
+    """Age in seconds of the freshest liveness evidence for one
+    transport's stamp.  Sequence progress is PREFERRED once history
+    exists: the age is measured on the scanner's own monotonic clock
+    from the moment the sequence value was first observed, so the
+    stamped host's wall clock cannot skew the verdict in either
+    direction.  Without seq history (first observation, pre-seq stamp)
+    the wall-clock age rules."""
+    seq_age = None
+    if seq is not None:
+        wall_age = max(0.0, now_wall - wall) if wall is not None else 0.0
+        with _seq_lock:
+            prev = _seq_track.get((key, rank))
+            if prev is None or prev[0] != seq:
+                # advanced since the previous scan: fresh — but only
+                # when there IS a previous scan; a first-ever
+                # observation of a possibly-stale stamp must not read
+                # as progress (its wall age is the baseline instead)
+                _seq_track[(key, rank)] = (
+                    seq, now_mono, 0.0 if prev is not None else wall_age)
+                seq_age = 0.0 if prev is not None else None
+            else:
+                # unchanged: age accrues on OUR clock from the first
+                # sighting, on top of how old the stamp already looked
+                # then — without the baseline, discovering an ancient
+                # stamp would read as "fresh" for one whole timeout
+                seq_age = prev[2] + (now_mono - prev[1])
+    if seq_age is not None:
+        return seq_age
+    if wall is None:
+        return None
+    return max(0.0, now_wall - wall)
+
+
+def rank_evidence(num_workers: int, directory: Optional[str] = None
+                  ) -> Dict[int, Optional[float]]:
+    """Freshest liveness-evidence age per rank in seconds (``None`` = no
+    evidence on any transport — the rank has never stamped).  Scans both
+    transports and takes the minimum age; returns an empty dict when no
+    transport is in active use (matching :func:`dead_nodes`'s
+    no-configuration behavior)."""
+    directory = directory or heartbeat_dir()
+    client = _kv_client()
+    kv = _kv_stamps(client) if client is not None else {}
+    kv_active = bool(kv)
+    dir_active = bool(directory) and os.path.isdir(directory)
+    files = _file_stamps(directory, num_workers) if dir_active else {}
+    if not kv_active and not dir_active:
+        return {}
+    now_wall, now_mono = time.time(), time.monotonic()
+    out: Dict[int, Optional[float]] = {}
+    for rank in range(num_workers):
+        ages = []
+        for key, stamps in (("kv", kv), (directory, files)):
+            if rank not in stamps:
+                continue
+            wall, seq = stamps[rank]
+            age = _evidence_age(key, rank, wall, seq, now_wall, now_mono)
+            if age is not None:
+                ages.append(age)
+        out[rank] = min(ages) if ages else None
     return out
 
 
 def dead_nodes(num_workers: int, timeout: float = 60.0,
                directory: Optional[str] = None) -> List[int]:
-    """Ranks with no fresh stamp on any transport within ``timeout``
-    seconds (the ``get_num_dead_node`` scan).  Empty when no transport is
-    configured — matching the reference's single-process behavior."""
-    directory = directory or heartbeat_dir()
-    client = _kv_client()
-    stamps = _kv_stamps(client) if client is not None else {}
-    kv_active = bool(stamps)        # kv transport is in use iff stamped
-    dir_active = bool(directory) and os.path.isdir(directory)
-    if dir_active:
-        for rank, ts in _file_stamps(directory, num_workers).items():
-            stamps[rank] = max(stamps.get(rank, 0.0), ts)
-    if not kv_active and not dir_active:
-        # no transport in active use (dir unset/removed, nobody stamped
-        # the kv store): report nothing dead, like the reference's
-        # single-process behavior — never declare a whole job dead on
-        # absence of configuration
+    """Ranks with no fresh liveness evidence on any transport within
+    ``timeout`` seconds (the ``get_num_dead_node`` scan).  Empty when no
+    transport is configured — matching the reference's single-process
+    behavior: never declare a whole job dead on absence of
+    configuration."""
+    evidence = rank_evidence(num_workers, directory=directory)
+    if not evidence:
         return []
-    now = time.time()
     return [rank for rank in range(num_workers)
-            if now - stamps.get(rank, 0.0) > timeout]
+            if evidence.get(rank) is None or evidence[rank] > timeout]

@@ -146,7 +146,7 @@ class BaseModule(object):
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, checkpoint=None, checkpoint_period=1,
-            resume=False):
+            resume=False, elastic=None):
         """The training driver: bind, init, then epochs of
         forward_backward/update/update_metric with callbacks.
 
@@ -157,7 +157,16 @@ class BaseModule(object):
         killed run re-launched with the same arguments continues from
         the newest INTACT checkpoint (torn or corrupt saves are skipped
         by the scan) and, given a deterministic iterator, reproduces the
-        uninterrupted run bit-for-bit (docs/how_to/resilience.md)."""
+        uninterrupted run bit-for-bit (docs/how_to/resilience.md).
+
+        ``elastic`` (an :class:`~mxnet_tpu.elastic.ElasticCoordinator`)
+        guards every batch with the collective-entry barrier: a dead
+        peer raises :class:`~mxnet_tpu.elastic.ElasticShrink` at the
+        next batch boundary instead of wedging the step's collectives —
+        the caller exits with ``elastic.SHRINK_EXIT_CODE`` and the
+        launcher relaunches the shrunk world, which resumes via
+        ``checkpoint``/``resume`` (docs/how_to/multi_host.md "Elastic
+        training")."""
         assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
             from ..initializer import Uniform
@@ -209,7 +218,8 @@ class BaseModule(object):
         try:
             for epoch in range(begin_epoch, num_epoch):
                 elapsed = self._train_epoch(epoch, train_data, eval_metric,
-                                            batch_end_callback, monitor)
+                                            batch_end_callback, monitor,
+                                            elastic=elastic)
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f",
                                      epoch, name, val)
@@ -291,14 +301,20 @@ class BaseModule(object):
             label_shardings=_sh(self._label_names))
 
     def _train_epoch(self, epoch, train_data, eval_metric,
-                     batch_end_callback, monitor):
+                     batch_end_callback, monitor, elastic=None):
         """One pass over ``train_data``; returns the wall time.
 
         Batch fetches ride :func:`~mxnet_tpu.resilience.retry_io`: a
         transient ``OSError`` from the input pipeline (flaky NFS read,
         preempted record fetch — or an injected ``io_error`` fault) is
         retried with backoff instead of killing the epoch; a persistent
-        one still propagates after the attempts run out."""
+        one still propagates after the attempts run out.
+
+        With ``elastic``, every batch is preceded by the coordinator's
+        collective-entry guard: no rank enters the fused step until all
+        members commit to it, and a lapsed member surfaces as
+        ``ElasticShrink`` HERE — at the batch boundary, with the device
+        state still coherent — instead of inside a hung collective."""
         from ..resilience import retry_io
         eval_metric.reset()
         tic = time.time()
@@ -311,6 +327,10 @@ class BaseModule(object):
                                       logger=self.logger)
             except StopIteration:
                 break
+            if elastic is not None:
+                trainer = getattr(self, "_trainer", None)
+                elastic.guard(trainer.num_update + 1
+                              if trainer is not None else None)
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(data_batch)

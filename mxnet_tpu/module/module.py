@@ -336,26 +336,15 @@ class Module(BaseModule):
 
     def _auto_global_mesh(self):
         """Widen the auto mesh to all processes' devices for multi-host
-        fused training.  Picks the largest per-process device count k
-        that divides the local batch (k=1 always qualifies, so with >1
-        process this succeeds); returns None only when there is just one
-        process — the caller then falls back to the classic executor
+        fused training (``parallel.global_data_parallel_mesh``: data
+        axis spans hosts, rank-major, per-process device count capped to
+        divide the local batch — k=1 always qualifies, so with >1
+        process this succeeds).  Returns None only when there is just
+        one process — the caller then falls back to the classic executor
         path so cross-host sync is never silently skipped."""
-        import jax
-        from ..parallel import make_mesh
-        local_batch = self._data_shapes[0].shape[0]
-        per_proc = {}
-        for d in jax.devices():
-            per_proc.setdefault(d.process_index, []).append(d)
-        k = min(len(v) for v in per_proc.values())
-        while k > 1 and local_batch % k != 0:
-            k -= 1
-        devs = []
-        for p in sorted(per_proc):
-            devs.extend(sorted(per_proc[p], key=lambda d: d.id)[:k])
-        if len(devs) <= k:      # single process after all
-            return None
-        return make_mesh({"data": len(devs)}, devs)
+        from ..parallel import global_data_parallel_mesh
+        return global_data_parallel_mesh(
+            local_batch=self._data_shapes[0].shape[0])
 
     def _build_param_mirrors(self):
         shapes = {d.name: d.shape for d in self._data_shapes}

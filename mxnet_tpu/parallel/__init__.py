@@ -18,7 +18,7 @@ one device Mesh + sharding annotations, with XLA inserting the collectives.
                          ``expert`` axis
 """
 from .mesh import (Mesh, get_mesh, current_mesh, data_parallel_mesh,
-                   make_mesh)
+                   global_data_parallel_mesh, make_mesh)
 from .collectives import global_allreduce, barrier
 from .trainer import Trainer
 from .ring_attention import ring_attention, ring_attention_sharded
@@ -26,7 +26,8 @@ from .pipeline import pipeline_apply
 from .moe import moe_init, moe_apply, moe_shardings, moe_load_balance_loss
 
 __all__ = ["Mesh", "get_mesh", "current_mesh", "data_parallel_mesh",
-           "make_mesh", "global_allreduce", "barrier", "Trainer",
+           "global_data_parallel_mesh", "make_mesh", "global_allreduce",
+           "barrier", "Trainer",
            "ring_attention", "ring_attention_sharded", "pipeline_apply",
            "moe_init", "moe_apply", "moe_shardings",
            "moe_load_balance_loss"]

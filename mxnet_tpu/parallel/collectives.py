@@ -29,6 +29,13 @@ def _process_count():
         return 1
 
 
+def _process_index():
+    try:
+        return jax.process_index()
+    except Exception:      # noqa: BLE001 — backend not yet initialized
+        return 0
+
+
 
 def broadcast_from_rank0(value):
     """Every process returns process 0's ``value`` (the reference's

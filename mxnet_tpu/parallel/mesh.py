@@ -20,7 +20,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["Mesh", "NamedSharding", "PartitionSpec", "get_mesh",
            "make_mesh", "current_mesh", "data_parallel_mesh",
-           "batch_sharding", "replicated", "zero_spec", "shard_map"]
+           "global_data_parallel_mesh", "batch_sharding", "replicated",
+           "zero_spec", "shard_map"]
 
 
 def _resolve_shard_map():
@@ -78,6 +79,41 @@ def data_parallel_mesh(num_devices: Optional[int] = None) -> Mesh:
     devices = jax.devices()
     n = num_devices or len(devices)
     return make_mesh({"data": n}, devices)
+
+
+def global_data_parallel_mesh(per_process: Optional[int] = None,
+                              axis: str = "data",
+                              local_batch: Optional[int] = None
+                              ) -> Optional[Mesh]:
+    """Process-spanning 1-D mesh: the ``data`` axis covers EVERY
+    process's devices in rank-major order (rank =
+    ``jax.process_index()``), so batch dim 0 shards across hosts and the
+    fused step's gradient psum rides DCN/ICI between them.  Call after
+    ``jax.distributed.initialize`` (the launcher env contract does this
+    at package import).
+
+    ``per_process`` caps the devices taken from each process — the mesh
+    must stay rectangular, so the default is the MINIMUM local device
+    count across processes; ``local_batch`` further lowers it to the
+    largest count dividing the per-process batch (k=1 always
+    qualifies).  Returns None for a single-process job: the caller
+    should use a local mesh (and never believe it has cross-host sync
+    when it does not)."""
+    per = {}
+    for d in jax.devices():
+        per.setdefault(d.process_index, []).append(d)
+    if len(per) <= 1:
+        return None
+    k = min(len(v) for v in per.values())
+    if per_process is not None:
+        k = min(k, int(per_process))
+    if local_batch is not None:
+        while k > 1 and local_batch % k != 0:
+            k -= 1
+    devs = []
+    for p in sorted(per):
+        devs.extend(sorted(per[p], key=lambda d: d.id)[:k])
+    return make_mesh({axis: len(devs)}, devs)
 
 
 def get_mesh(num_devices: Optional[int] = None) -> Mesh:

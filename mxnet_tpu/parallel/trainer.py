@@ -34,6 +34,8 @@ from .. import faults as _faults
 from .mesh import batch_sharding, replicated
 from .optim import make_update_fn
 
+from .collectives import _process_index
+
 __all__ = ["Trainer", "remat_policy"]
 
 # dynamic loss-scale schedule (the standard GradScaler constants): halve
@@ -922,6 +924,15 @@ class Trainer:
                 lr = self.optimizer.lr
         key = jax.random.fold_in(self._key, self.num_update) \
             if self.prog.has_rng else self._key
+        # whole-host death (docs/how_to/multi_host.md "Elastic
+        # training"): SIGKILL-faithful, before this rank's shard enters
+        # the step collectives.  Elastic runs hit the same directive one
+        # layer up (ElasticCoordinator.guard, before the step barrier);
+        # this site covers non-elastic runs.
+        if _faults.hit("host_dead", step=self.num_update,
+                       rank=_process_index()):
+            import os
+            os._exit(137)
         dev_batch = self._device_batch(batch)
         # fault injection (docs/how_to/resilience.md): poison the staged
         # batch so the backward materializes non-finite grads and the

@@ -35,6 +35,11 @@ def pytest_configure(config):
         "nightly: minute-plus compile-heavy coverage (example smokes, "
         "the C-ABI training drive) that the fast gate defers to the "
         "MXTPU_CI_FULL=1 tier to stay inside its wall-time bound")
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-subprocess e2e drills excluded from the tier-1 "
+        "window (-m 'not slow'); ci/run_tests.sh runs them unfiltered "
+        "in their own hard-timeout stages")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,227 @@
+#!/usr/bin/env python
+"""Elastic shrink-and-resume drill (docs/how_to/multi_host.md "Elastic
+training").
+
+Run under the elastic launcher with a whole-host death injected::
+
+    MXTPU_FAULTS="host_dead@step=11:rank=1" \
+        python tools/launch.py --local-elastic 2 -- \
+        python tests/nightly/elastic_train.py <workdir>
+
+Round 1: n workers train with per-step membership guards; rank 0
+checkpoints each epoch through CheckpointManager.  The targeted rank
+``os._exit``s at its step-11 guard (before committing to the step
+barrier, so no survivor enters the collective without it); the
+survivors' guards detect the lapsed heartbeat, the lowest surviving
+rank publishes the shrunk membership epoch, and every survivor exits
+``SHRINK_EXIT_CODE`` at the batch boundary.  Round 2 (launcher-driven):
+the surviving world relaunches, auto-resumes from the newest intact
+manifest, and trains to completion — recording the resumed-first-step
+wallclock the launcher turns into ``ELASTIC_RECOVERY_S``.
+
+``--replay E`` is the parity reference: a fresh single-process run that
+loads checkpoint epoch E from the same workdir and trains the same
+remaining epochs.  Its final params must be BIT-IDENTICAL to the
+elastic run's (tests/test_elastic.py asserts it).
+
+Data parallelism modes, picked by a capability probe: on backends with
+multiprocess XLA computations (TPU pods) the Module auto-widens onto
+the process-spanning global mesh (``kvstore=dist_sync_tpu``: real
+cross-host grad psum, ZeRO-1 state sharding and bf16 grad comm
+included); on backends without them (this CPU jax: "Multiprocess
+computations aren't implemented") every rank trains a bit-identical
+full-batch replica — the elastic choreography (heartbeats, epochs,
+barrier, shrink, resume) is identical in both modes.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                os.pardir, os.pardir))
+
+import numpy as np
+
+LOCAL_BATCH = 16
+N_ROWS = 128
+TOTAL_EPOCHS = 4
+
+
+def _net(mx):
+    net = mx.sym.FullyConnected(mx.sym.Variable("data"), num_hidden=32,
+                                name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return mx.sym.SoftmaxOutput(net, name="softmax")
+
+
+def _data():
+    rng = np.random.RandomState(7)            # same on every worker
+    x = rng.normal(0, 1, (N_ROWS, 16)).astype("f")
+    y = (x @ rng.normal(0, 1, (16, 4))).argmax(1).astype("f")
+    return x, y
+
+
+def _can_collective():
+    """Whether this backend can run multiprocess XLA computations (TPU
+    pods: yes; this CPU jax: no — the probe raises)."""
+    try:
+        import jax.numpy as jnp
+        from mxnet_tpu.parallel.collectives import broadcast_from_rank0
+        broadcast_from_rank0(jnp.zeros((1,), jnp.float32))
+        return True
+    except Exception as e:                      # noqa: BLE001
+        print("elastic_train: multiprocess collectives unavailable "
+              "(%s: %s); replica-mode data parallelism"
+              % (type(e).__name__, str(e).splitlines()[0] if str(e)
+                 else ""), flush=True)
+        return False
+
+
+def _final_path(workdir, replay):
+    return os.path.join(workdir,
+                        "replay-final.npz" if replay else "final.npz")
+
+
+def _save_final(mod, workdir, replay=False):
+    arg, _ = mod.get_params()
+    np.savez(_final_path(workdir, replay),
+             **{k: v.asnumpy() for k, v in arg.items()})
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("workdir")
+    ap.add_argument("--epochs", type=int, default=TOTAL_EPOCHS)
+    ap.add_argument("--replay", type=int, default=None, metavar="EPOCH",
+                    help="parity reference: fresh single-process run "
+                    "resumed from checkpoint EPOCH")
+    args = ap.parse_args()
+
+    # tight drill timings (each still overridable by the caller)
+    os.environ.setdefault("MXTPU_ELASTIC_HB_TIMEOUT_S", "4")
+    os.environ.setdefault("MXTPU_ELASTIC_STEP_TIMEOUT_S", "12")
+    os.environ.setdefault("MXTPU_ELASTIC_CHECK_S", "0.5")
+    os.environ.setdefault("MXTPU_MODULE_FUSED", "always")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic, resilience
+
+    os.makedirs(args.workdir, exist_ok=True)
+    prefix = os.path.join(args.workdir, "ckpt")
+    mgr = resilience.CheckpointManager(prefix, keep=50)
+    mx.random.seed(0)
+    x, y = _data()
+
+    if args.replay is not None:
+        ck = mgr.verify(args.replay)
+        assert ck is not None, "no intact checkpoint at epoch %d" \
+            % args.replay
+        _, arg_params, aux_params = ck.load_params()
+        mod = mx.mod.Module(_net(mx), context=mx.cpu())
+        if ck.states_path:
+            mod._preload_opt_states = ck.states_path
+        it = mx.io.NDArrayIter(x, y, batch_size=LOCAL_BATCH, shuffle=False)
+        mod.fit(it, num_epoch=args.epochs, begin_epoch=ck.epoch,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.2,
+                                  "rescale_grad": 1.0 / LOCAL_BATCH},
+                arg_params=arg_params, aux_params=aux_params,
+                allow_missing=False, initializer=None)
+        _save_final(mod, args.workdir, replay=True)
+        print("elastic_train: replay from epoch %d done" % ck.epoch,
+              flush=True)
+        return 0
+
+    rank = int(os.environ.get("MXTPU_PROCESS_ID", "0") or 0)
+    nworker = int(os.environ.get("MXTPU_NUM_PROCESSES", "1") or 1)
+    coord = elastic.ElasticCoordinator(rank=rank, num_workers=nworker)
+    fused_global = nworker > 1 and _can_collective()
+    if fused_global:
+        # real cross-host data parallelism: per-rank shard, global mesh
+        # (Module auto-widens), ZeRO-1 + bf16 grad wire across hosts
+        os.environ.setdefault("MXTPU_ZERO", "1")
+        os.environ.setdefault("MXTPU_GRAD_DTYPE", "bf16")
+        kv = mx.kv.create("dist_sync_tpu")
+        xs, ys = x[rank::nworker], y[rank::nworker]
+        rescale = 1.0 / (LOCAL_BATCH * nworker)
+    else:
+        # replica mode: every rank consumes the identical full-batch
+        # stream, so ranks stay bit-identical with no collectives — the
+        # membership/shrink/resume choreography under test is the same
+        kv = "local"
+        xs, ys = x, y
+        rescale = 1.0 / LOCAL_BATCH
+
+    begin = 0
+    arg_params = aux_params = None
+    ck = mgr.latest()
+    mod = mx.mod.Module(_net(mx), context=mx.cpu())
+    if ck is not None:
+        _, arg_params, aux_params = ck.load_params()
+        begin = ck.epoch
+        if ck.states_path:
+            mod._preload_opt_states = ck.states_path
+        print("worker %d/%d: auto-resume from checkpoint epoch %d "
+              "(step %s)" % (rank, nworker, begin, ck.step), flush=True)
+        with open(os.path.join(args.workdir, "resume-info.json"),
+                  "w") as f:
+            json.dump({"resumed_epoch": begin, "world": nworker}, f)
+
+    stamped = []
+
+    def _first_step_cb(param):
+        # resumed-first-step wallclock: the "recovered" end of
+        # elastic_recovery_s, read by the launcher from the shared
+        # elastic dir
+        if ck is None or stamped or rank != 0:
+            return
+        stamped.append(time.time())
+        edir = os.environ.get("MXTPU_ELASTIC_DIR")
+        if edir:
+            with open(os.path.join(edir, "resume-status.json"), "w") as f:
+                json.dump({"first_step_wall": stamped[0],
+                           "resumed_epoch": begin, "world": nworker}, f)
+
+    it = mx.io.NDArrayIter(xs, ys, batch_size=LOCAL_BATCH, shuffle=False)
+    try:
+        mod.fit(it, num_epoch=args.epochs, begin_epoch=begin, kvstore=kv,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": 0.2,
+                                  "rescale_grad": rescale},
+                arg_params=arg_params, aux_params=aux_params,
+                allow_missing=False,
+                initializer=mx.init.Xavier(rnd_type="gaussian",
+                                           magnitude=2.0),
+                checkpoint=(mgr if rank == 0 else None),
+                checkpoint_period=1,
+                batch_end_callback=_first_step_cb,
+                elastic=coord)
+    except elastic.ElasticShrink as e:
+        revoked = isinstance(e, elastic.ElasticRevoked)
+        print("worker %d/%d: %s — %s" % (
+            rank, nworker,
+            "revoked (declared dead); exiting cleanly" if revoked
+            else "membership shrank; exiting for relaunch", e),
+            flush=True)
+        coord.close()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        # os._exit, not sys.exit: the atexit chain includes
+        # jax.distributed shutdown, which would block on the DEAD peer
+        # until the launcher's straggler grace kills us — the world this
+        # process belonged to no longer exists, so skip the pleasantries
+        os._exit(elastic.SHRINK_EXIT_CODE)
+
+    if rank == 0:
+        _save_final(mod, args.workdir)
+    coord.close()
+    print("worker %d/%d: elastic train done (resumed from %s)"
+          % (rank, nworker, begin if ck is not None else None), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,49 @@ def test_heartbeat_stamp_fault_injection(tmp_path, monkeypatch):
         faults.clear()
 
 
+def test_seq_progress_overrides_skewed_ahead_clock(tmp_path, monkeypatch):
+    """A rank whose wall clock runs far AHEAD cannot stamp itself alive
+    into the future: once its sequence number has been observed and
+    stops advancing, sequence-progress age (the scanner's own monotonic
+    clock) rules the verdict even while the stamp's wall time — and a
+    freshly rewritten mtime — still claim alive."""
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    health._reset_seq_cache()
+    stamp = "%f 5" % (time.time() + 1e6)       # far-future wall clock
+    (tmp_path / "hb-0").write_text(stamp)
+    # first observation: only wall evidence exists — alive
+    assert health.dead_nodes(1, timeout=0.2) == []
+    time.sleep(0.35)
+    # same seq rewritten (fresh mtime, future wall): both wall signals
+    # say alive, sequence progress says 0.35s of silence — dead
+    (tmp_path / "hb-0").write_text(stamp)
+    assert health.dead_nodes(1, timeout=0.2) == [0]
+
+
+def test_seq_progress_saves_skewed_behind_clock(tmp_path, monkeypatch):
+    """A rank whose wall clock runs far BEHIND (ancient stamp content
+    and mtime) is NOT declared dead while its sequence number keeps
+    advancing between scans."""
+    import os
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    health._reset_seq_cache()
+    path = tmp_path / "hb-0"
+
+    def stamp(seq):
+        path.write_text("1.0 %d" % seq)        # wall clock stuck in 1970
+        os.utime(path, (1.0, 1.0))             # mtime equally ancient
+    stamp(5)
+    # first observation: wall evidence only — (correctly) stale
+    assert health.dead_nodes(1, timeout=30.0) == [0]
+    stamp(6)
+    # the sequence advanced between scans: progress is fresh evidence
+    # on the scanner's clock, wall age notwithstanding
+    assert health.dead_nodes(1, timeout=30.0) == []
+    time.sleep(0.3)
+    # and once it stops advancing, staleness returns on seq age
+    assert health.dead_nodes(1, timeout=0.2) == [0]
+
+
 def test_heartbeat_registered_for_atexit_stop(tmp_path, monkeypatch):
     monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
     h = health.Heartbeat(0, interval=0.05)

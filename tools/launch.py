@@ -21,6 +21,11 @@ Launch modes:
   dmlc local tracker trick used by ``tests/nightly/dist_sync_kvstore.py``);
   each gets ``JAX_PLATFORMS=cpu`` and a private ``XLA_FLAGS`` virtual-device
   count so collectives are exercised without a pod.
+* ``--local-elastic N`` — local mode with ELASTIC membership: a dead
+  worker triggers heartbeat detection and a membership-epoch shrink
+  (``mxnet_tpu.elastic``); this launcher relaunches the surviving world
+  size and the job auto-resumes from its newest intact checkpoint
+  (docs/how_to/multi_host.md "Elastic training").
 * ``ssh``    — one process per line of ``--host-file``, same binary+args,
   envs injected over ssh (reference ssh tracker analog).
 * ``gcloud`` — print (or run) the ``gcloud compute tpus tpu-vm ssh --worker=all``
@@ -43,6 +48,43 @@ def _free_port():
     return port
 
 
+def _worker_env(args, rank, num_workers, coordinator, hb_dir,
+                elastic_dir=None):
+    """The per-worker env contract, shared by the plain and elastic
+    local launchers so it can never diverge between them."""
+    env = dict(os.environ)
+    # a site-injected TPU backend would initialize XLA at interpreter
+    # start, before jax.distributed.initialize can run — strip it;
+    # local mode is CPU-only by design
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.update({
+        "MXTPU_COORDINATOR": coordinator,
+        "MXTPU_NUM_PROCESSES": str(num_workers),
+        "MXTPU_PROCESS_ID": str(rank),
+        # local mode runs on host CPU devices
+        "JAX_PLATFORMS": "cpu",
+        "TPU_SKIP_MDS_QUERY": "1",
+    })
+    if os.environ.get("MXTPU_HEARTBEAT_TRANSPORT", "dir") != "kv":
+        # file liveness stamps for KVStore.num_dead_node; with
+        # transport "kv" the stamps ride the jax.distributed
+        # coordination service instead (no shared filesystem needed —
+        # the multi-host default; health.py scans both)
+        env["MXTPU_HEARTBEAT_DIR"] = hb_dir
+    else:
+        env.pop("MXTPU_HEARTBEAT_DIR", None)
+    if elastic_dir is not None:
+        # membership record + step barriers need the shared dir even
+        # when heartbeats ride the kv transport
+        env["MXTPU_ELASTIC_DIR"] = elastic_dir
+        env["MXTPU_ELASTIC"] = "1"
+    if args.devices_per_worker:
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=%d"
+                            % args.devices_per_worker)
+    return env
+
+
 def _run_local_once(args, allow_grace):
     """One attempt: fork N workers, tear the job down if any crashes."""
     import shutil
@@ -51,34 +93,10 @@ def _run_local_once(args, allow_grace):
     port = _free_port()
     coordinator = "127.0.0.1:%d" % port
     hb_dir = tempfile.mkdtemp(prefix="mxtpu-hb-")
-    procs = []
-    for rank in range(args.num_workers):
-        env = dict(os.environ)
-        # a site-injected TPU backend would initialize XLA at interpreter
-        # start, before jax.distributed.initialize can run — strip it;
-        # local mode is CPU-only by design
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.update({
-            "MXTPU_COORDINATOR": coordinator,
-            "MXTPU_NUM_PROCESSES": str(args.num_workers),
-            "MXTPU_PROCESS_ID": str(rank),
-            # local mode runs on host CPU devices
-            "JAX_PLATFORMS": "cpu",
-            "TPU_SKIP_MDS_QUERY": "1",
-        })
-        if os.environ.get("MXTPU_HEARTBEAT_TRANSPORT", "dir") != "kv":
-            # file liveness stamps for KVStore.num_dead_node; with
-            # transport "kv" the stamps ride the jax.distributed
-            # coordination service instead (no shared filesystem needed —
-            # the multi-host default; health.py scans both)
-            env["MXTPU_HEARTBEAT_DIR"] = hb_dir
-        else:
-            env.pop("MXTPU_HEARTBEAT_DIR", None)
-        if args.devices_per_worker:
-            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                                " --xla_force_host_platform_device_count=%d"
-                                % args.devices_per_worker)
-        procs.append(subprocess.Popen(args.command, env=env))
+    procs = [subprocess.Popen(args.command,
+                              env=_worker_env(args, rank, args.num_workers,
+                                              coordinator, hb_dir))
+             for rank in range(args.num_workers)]
     code = 0
 
     def _kill_all(signum=None, frame=None):
@@ -133,6 +151,137 @@ def launch_local(args):
     return code
 
 
+def _wait_elastic(procs, grace):
+    """Wait for every worker.  The first exit (a death OR a clean
+    shrink-exit) arms a straggler deadline: survivors get ``grace``
+    seconds to run their own detection and exit with the shrink code;
+    anything still alive after that is killed (a wedged survivor must
+    not hang the orchestration).  Returns the exit codes."""
+    import time as _time
+    deadline = None
+    while True:
+        live = [p for p in procs if p.poll() is None]
+        if not live:
+            return [p.returncode for p in procs]
+        if deadline is None and len(live) < len(procs):
+            deadline = _time.monotonic() + grace
+        if deadline is not None and _time.monotonic() > deadline:
+            for p in live:
+                p.terminate()
+            for p in live:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+            return [p.returncode for p in procs]
+        _time.sleep(0.1)
+
+
+def launch_local_elastic(args):
+    """Elastic local orchestration (``--local-elastic N``): dead-host
+    detection, membership shrink, survivor relaunch, checkpoint resume.
+
+    Each round forks the current world; mxnet_tpu.elastic inside the
+    workers does the detection half (heartbeats -> membership epochs ->
+    ``ElasticShrink`` -> exit ``SHRINK_EXIT_CODE``).  This loop does the
+    orchestration half: when a round ends with a published shrink (or a
+    dead worker), it relaunches ONLY the surviving world size — the
+    relaunched job re-initializes ``jax.distributed`` over the shrunk
+    world and auto-resumes from the newest intact checkpoint.  At
+    success it prints ``ELASTIC_RECOVERY_S=<detect -> resumed-first-step
+    seconds>`` (the number bench.py reports as ``elastic_recovery_s``)
+    when both timestamps were recorded."""
+    import json
+    import shutil
+    import tempfile
+    import time as _time
+
+    # workers exiting because the membership shrank (mxnet_tpu.elastic
+    # SHRINK_EXIT_CODE — mirrored here so the launcher stays importable
+    # without the package)
+    shrink_rc = 96
+    n = args.num_workers
+    edir = tempfile.mkdtemp(prefix="mxtpu-elastic-")
+    detect_wall = None
+    rounds = 0
+    try:
+        while True:
+            rounds += 1
+            port = _free_port()
+            procs = [subprocess.Popen(
+                args.command,
+                env=_worker_env(args, rank, n, "127.0.0.1:%d" % port,
+                                edir, elastic_dir=edir))
+                for rank in range(n)]
+
+            def _kill_all(signum=None, frame=None):
+                for p in procs:
+                    if p.poll() is None:
+                        p.terminate()
+
+            signal.signal(signal.SIGINT, _kill_all)
+            signal.signal(signal.SIGTERM, _kill_all)
+            codes = _wait_elastic(procs, args.elastic_grace)
+
+            membership = None
+            try:
+                with open(os.path.join(edir, "membership.json")) as f:
+                    membership = json.load(f)
+            except (OSError, ValueError):
+                pass
+            if all(c == 0 for c in codes):
+                status = None
+                try:
+                    with open(os.path.join(edir,
+                                           "resume-status.json")) as f:
+                        status = json.load(f)
+                except (OSError, ValueError):
+                    pass
+                if detect_wall is not None and status \
+                        and status.get("first_step_wall"):
+                    print("ELASTIC_RECOVERY_S=%.2f"
+                          % (status["first_step_wall"] - detect_wall),
+                          flush=True)
+                print("launch.py: elastic job complete (world=%d after "
+                      "%d round(s))" % (n, rounds), flush=True)
+                return 0
+            if membership is not None and membership.get("epoch", 1) > 1 \
+                    and len(membership.get("world", [])) < n:
+                new_n = len(membership["world"])
+                detect_wall = membership.get("wallclock") or _time.time()
+                print("launch.py: membership epoch %d — dead=%s; "
+                      "shrinking %d -> %d and relaunching survivors"
+                      % (membership["epoch"], membership.get("dead"),
+                         n, new_n), flush=True)
+            else:
+                # no published shrink (e.g. every worker died before a
+                # survivor could publish): drop the ranks that failed
+                dead = sum(1 for c in codes if c not in (0, shrink_rc))
+                new_n = n - dead
+                detect_wall = _time.time()
+                print("launch.py: %d worker(s) died without a published "
+                      "shrink (codes=%s); relaunching %d"
+                      % (dead, codes, new_n), flush=True)
+            if new_n < 1 or new_n >= n:
+                code = next((c for c in codes if c != 0), 1)
+                print("launch.py: elastic job failed (codes=%s)" % codes,
+                      flush=True)
+                return code
+            n = new_n
+            # fresh coordination state for the new incarnation: stale
+            # heartbeat/barrier stamps and the old-world membership must
+            # not leak into the relaunched job (the relaunch assigns new
+            # contiguous ranks)
+            for name in os.listdir(edir):
+                try:
+                    os.remove(os.path.join(edir, name))
+                except OSError:
+                    pass
+    finally:
+        shutil.rmtree(edir, ignore_errors=True)
+
+
 def launch_ssh(args):
     with open(args.host_file) as f:
         hosts = [h.strip() for h in f if h.strip() and
@@ -180,6 +329,18 @@ def main():
                         help="auto-restart mode: seconds between a worker "
                         "crash and job teardown, letting survivors log "
                         "num_dead_node detection")
+    parser.add_argument("--local-elastic", type=int, default=0,
+                        metavar="N",
+                        help="elastic local mode: N workers with "
+                        "membership-epoch shrink — a dead worker is "
+                        "detected via heartbeats, survivors exit at the "
+                        "batch boundary, and the job relaunches at the "
+                        "shrunk world size, resuming from the newest "
+                        "intact checkpoint (docs/how_to/multi_host.md)")
+    parser.add_argument("--elastic-grace", type=float, default=90.0,
+                        help="elastic mode: seconds survivors get, after "
+                        "the first worker exit, to run their own "
+                        "detection and exit before being killed")
     parser.add_argument("-H", "--host-file", default=None,
                         help="ssh mode: one host per line")
     parser.add_argument("--port", type=int, default=9000,
@@ -195,6 +356,9 @@ def main():
         parser.error("no command given")
     if args.command[0] == "--":
         args.command = args.command[1:]
+    if args.local_elastic:
+        args.num_workers = args.local_elastic
+        sys.exit(launch_local_elastic(args))
     if args.launcher == "local":
         sys.exit(launch_local(args))
     elif args.launcher == "ssh":
