@@ -132,6 +132,27 @@ stage "graph lint gate (trace-time, no device execution)"
 # prints the finding summary — docs/how_to/graph_lint.md
 python tools/graph_lint.py --check
 
+stage "concurrency sanitizer gate (static lint + MXTPU_TSAN=1 lockset sweep)"
+# half 1: the AST thread-safety rules over mxnet_tpu/ (no imports, no
+# devices) gated on RACE_BASELINE.json — unnamed threads, undeclared
+# daemon policy, unlocked thread-target mutation, blocking calls under
+# a lock.  half 2: re-run the serving + stream-pipeline + elastic unit
+# suites with the runtime lockset/lock-order recorder ON, then replay
+# the combined event log and FAIL on any non-baseline finding (the
+# committed baseline is all-zeros: a real race gets fixed, not
+# baselined).  HARD timeout: an instrumented deadlock must fail this
+# stage, not hang the suite.  Measured overhead of the instrumented
+# sweep is ~1.1x the plain run (well inside the 2x budget) —
+# docs/how_to/static_analysis.md
+python tools/concurrency_lint.py --check
+TSAN_LOG="$(mktemp)"
+timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 \
+    MXTPU_TSAN_LOG="$TSAN_LOG" \
+    python -m pytest tests/test_serving.py tests/test_stream_pipeline.py \
+        tests/test_elastic.py -q -m "not slow"
+python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
+rm -f "$TSAN_LOG"
+
 stage "overlapped stream input pipeline (2-process decode ring, chunked H2D)"
 # the multi-process decode ring + chunked staging + on-device augment
 # suite (2 decode worker processes / preprocess_threads=2, pinned to

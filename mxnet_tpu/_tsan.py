@@ -1,0 +1,462 @@
+"""Runtime concurrency-sanitizer primitives (``MXTPU_TSAN=1``).
+
+The host-side runtime is now heavily threaded — the serving scheduler,
+the upload-staging worker, heartbeat stampers, decode producers, the
+native engine's dispatch threads — and the only correctness tooling
+before this module looked at *graphs*, not at the threads the p99 and
+the elastic-shrink protocol actually ride on.  This is the recording
+half of the repo's Eraser-style lockset checker (the analysis half is
+``mxnet_tpu/analysis/concurrency/``): an **opt-in** instrumentation
+layer that, when enabled, records
+
+* ``acquire``/``release`` of the framework's named locks (created via
+  :func:`lock` / :func:`rlock` / :func:`condition`), maintaining a
+  per-thread held-lock stack and a **lock acquisition graph** (an edge
+  ``A -> B`` means some thread acquired ``B`` while holding ``A`` — a
+  cycle is a potential deadlock), and
+* ``read``/``write`` of **registered shared state** (:func:`note_read` /
+  :func:`note_write` call sites in the runtime: server queues, upload
+  staging counters, heartbeat stamp files, engine var lists), each
+  tagged with the accessing thread and the lockset it held — the raw
+  material for lockset-violation findings.
+
+Design constraints honoured here:
+
+* **zero overhead when off** — with ``MXTPU_TSAN`` unset, :func:`lock`
+  and friends return *plain* ``threading`` primitives and every
+  ``note_*`` site is behind an inert module-attribute boolean check; no
+  wrapper object, no event, no allocation.
+* **bounded when on** — events are deduplicated at the source by
+  signature ``(kind, label, thread, held-lockset)``; steady-state
+  repetition of an already-seen access records (and logs) nothing, so
+  a million-request serving run produces a few hundred events.
+* **dependency-free** — this module imports only the stdlib, so any
+  runtime module (``io``, ``engine``, ``health``, ``serving``) can
+  import it without cycles.
+
+Event log: with ``MXTPU_TSAN_LOG=<path>`` every novel event is appended
+as one JSON line (flushed periodically and at interpreter exit), so a
+CI sweep can run the instrumented suites and replay the log through
+``tools/concurrency_lint.py --replay`` in a separate process.
+
+Labels are *class-level*, not instance-level (every
+``DeviceUploadIter`` worker records against the same
+``io.DeviceUploadIter.stats`` label): the checker validates locking
+**discipline** — state of kind X is only ever touched under lock of
+kind Y — which is what holds across instances; per-instance aliasing
+is out of scope.  See ``docs/how_to/static_analysis.md``.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import traceback
+from typing import Dict, List, Optional, Tuple
+
+__all__ = [
+    "TSAN", "enable", "disable", "enabled", "scoped",
+    "lock", "rlock", "condition", "TsanLock",
+    "note_read", "note_write", "snapshot", "dump", "flush_log",
+    "parse_log",
+]
+
+# the inert fast-path flag: hot call sites guard with `if _tsan.TSAN:`
+# (one module-attribute load when off — the "exactly zero" contract is
+# "no instrumentation installed": plain threading primitives, no
+# wrappers, no events)
+TSAN = os.environ.get("MXTPU_TSAN", "") == "1"
+
+_STACK_DEPTH = int(os.environ.get("MXTPU_TSAN_STACK", "") or 5)
+_FLUSH_EVERY = 256
+_MAX_EXAMPLES = 8          # provenance samples kept per state/edge
+
+
+def _stack_str(skip: int = 2) -> str:
+    """Compact ``file:line(func)`` provenance, innermost last, with the
+    recorder's own frames dropped."""
+    frames = traceback.extract_stack(limit=_STACK_DEPTH + skip + 2)
+    out = []
+    for fr in frames:
+        if fr.filename.endswith("_tsan.py"):
+            continue
+        out.append("%s:%d(%s)" % (os.path.basename(fr.filename),
+                                  fr.lineno, fr.name))
+    return " <- ".join(reversed(out[-_STACK_DEPTH:]))
+
+
+def _thread_key() -> str:
+    t = threading.current_thread()
+    return "%s#%d" % (t.name, t.ident or 0)
+
+
+class _Recorder:
+    """Aggregating event recorder.  All shared structures live behind
+    one plain (never-instrumented) lock; the per-thread held-lock stack
+    is thread-local and needs none."""
+
+    def __init__(self, log_path: Optional[str] = None):
+        # the event log is PER RECORDER: a scoped() test recorder must
+        # never append its deliberately-racy fixture events to the log
+        # a live MXTPU_TSAN=1 sweep is collecting
+        self.log_path = log_path
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._seen: set = set()
+        # state label -> {threads, writers, common lockset (None until
+        #                 first access), lockfree, reason, examples}
+        self.states: Dict[str, dict] = {}
+        # (held, acquired) -> [(thread, stack), ...]  (first few)
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, str]]] = {}
+        self._buffer: List[str] = []
+
+    # ------------------------------------------------------- held stack
+    def held(self) -> List[str]:
+        h = getattr(self._tls, "held", None)
+        if h is None:
+            h = []
+            self._tls.held = h
+        return h
+
+    # ---------------------------------------------------------- events
+    def on_acquire(self, label: str) -> None:
+        held = self.held()
+        sig = ("acq", label, _thread_key(), tuple(held))
+        with self._mu:
+            novel = sig not in self._seen
+            if novel:
+                self._seen.add(sig)
+        if novel:
+            stack = _stack_str()
+            thread = _thread_key()
+            with self._mu:
+                for h in held:
+                    if h != label:
+                        ex = self.edges.setdefault((h, label), [])
+                        if len(ex) < _MAX_EXAMPLES:
+                            ex.append((thread, stack))
+                self._log({"k": "acq", "o": label, "t": thread,
+                           "h": list(held), "s": stack})
+        held.append(label)
+
+    def on_release(self, label: str) -> None:
+        held = self.held()
+        # remove the most recent acquisition of this label (locks are
+        # not required to be released in LIFO order)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == label:
+                del held[i]
+                break
+
+    def on_access(self, kind: str, label: str, lockfree: bool,
+                  reason: str) -> None:
+        held = tuple(self.held())
+        thread = _thread_key()
+        sig = (kind, label, thread, held)
+        with self._mu:
+            if sig in self._seen:
+                return
+            self._seen.add(sig)
+        stack = _stack_str()
+        with self._mu:
+            st = self.states.setdefault(label, {
+                "threads": set(), "writers": set(), "common": None,
+                "lockfree": False, "reason": "", "examples": []})
+            st["threads"].add(thread)
+            if kind == "write":
+                st["writers"].add(thread)
+            held_set = frozenset(held)
+            st["common"] = held_set if st["common"] is None \
+                else st["common"] & held_set
+            if lockfree:
+                st["lockfree"] = True
+                if reason:
+                    st["reason"] = reason
+            if len(st["examples"]) < _MAX_EXAMPLES:
+                st["examples"].append(
+                    {"thread": thread, "kind": kind,
+                     "held": list(held), "stack": stack})
+            ev = {"k": kind, "o": label, "t": thread, "h": list(held),
+                  "s": stack}
+            if lockfree:
+                ev["lf"] = True
+                if reason:
+                    ev["why"] = reason
+            self._log(ev)
+
+    # ------------------------------------------------------------- log
+    def _log(self, event: dict) -> None:
+        """Buffer one JSONL event (caller holds ``_mu``)."""
+        if self.log_path is None:
+            return
+        self._buffer.append(json.dumps(event, sort_keys=True))
+
+    def flush(self) -> None:
+        """Append buffered events to this recorder's log.  The file
+        write happens OUTSIDE the recorder lock (our own blocking-call-
+        under-lock rule applies to us too)."""
+        with self._mu:
+            lines, self._buffer = self._buffer, []
+        if not lines or self.log_path is None:
+            return
+        try:
+            with open(self.log_path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass
+
+    def maybe_flush(self) -> None:
+        if self.log_path is not None and len(self._buffer) >= _FLUSH_EVERY:
+            self.flush()
+
+    # -------------------------------------------------------- snapshot
+    def snapshot(self) -> dict:
+        """Plain-data view of the aggregates (what the analysis pass
+        and the replay path both consume)."""
+        with self._mu:
+            states = {}
+            for label, st in self.states.items():
+                states[label] = {
+                    "threads": sorted(st["threads"]),
+                    "writers": sorted(st["writers"]),
+                    "common": sorted(st["common"])
+                    if st["common"] is not None else None,
+                    "lockfree": st["lockfree"],
+                    "reason": st["reason"],
+                    "examples": list(st["examples"]),
+                }
+            edges = {"%s\x00%s" % k: list(v)
+                     for k, v in self.edges.items()}
+        return {"states": states, "edges": edges}
+
+
+_REC = _Recorder(os.environ.get("MXTPU_TSAN_LOG") or None)
+_SWAP_MU = threading.Lock()
+
+
+def recorder() -> _Recorder:
+    return _REC
+
+
+def enabled() -> bool:
+    return TSAN
+
+
+def enable() -> None:
+    """Turn recording on (``MXTPU_TSAN=1`` does this at import).  Locks
+    created BEFORE enabling stay plain — enable first, construct
+    after (the env-var path naturally does)."""
+    global TSAN
+    TSAN = True
+
+
+def disable() -> None:
+    global TSAN
+    TSAN = False
+
+
+class scoped:
+    """Context manager: fresh recorder + forced-on TSAN for the scope,
+    both restored on exit.  Lets tests exercise deliberately racy
+    fixtures without polluting (or being polluted by) the process-wide
+    recorder of an ``MXTPU_TSAN=1`` CI sweep — the scoped recorder has
+    NO log path, so fixture events never reach the sweep's
+    ``MXTPU_TSAN_LOG`` either."""
+
+    def __enter__(self) -> _Recorder:
+        global _REC, TSAN
+        with _SWAP_MU:
+            self._prev_rec, self._prev_on = _REC, TSAN
+            _REC = _Recorder()
+            TSAN = True
+        return _REC
+
+    def __exit__(self, *exc):
+        global _REC, TSAN
+        with _SWAP_MU:
+            _REC = self._prev_rec
+            TSAN = self._prev_on
+        return False
+
+
+# ----------------------------------------------------------------------
+# instrumented lock
+class TsanLock:
+    """A named ``threading.Lock``/``RLock`` wrapper that records
+    acquisition order and maintains the per-thread held set.  Only the
+    OUTERMOST acquire/release of a reentrant lock records (recursion is
+    not an ordering event).  Implements ``_is_owned`` so it can back a
+    ``threading.Condition`` (whose ``wait`` releases and re-acquires
+    through this wrapper, keeping the held set faithful across the
+    wait)."""
+
+    __slots__ = ("label", "_inner", "_owner", "_count")
+
+    def __init__(self, label: str, reentrant: bool = False):
+        self.label = label
+        self._inner = threading.RLock() if reentrant else threading.Lock()
+        self._owner: Optional[int] = None
+        self._count = 0
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if not ok:
+            return False
+        me = threading.get_ident()
+        if self._owner == me:
+            self._count += 1            # reentrant re-entry: no event
+        else:
+            self._owner = me
+            self._count = 1
+            _REC.on_acquire(self.label)
+            _REC.maybe_flush()
+        return True
+
+    def release(self) -> None:
+        me = threading.get_ident()
+        if self._owner == me:
+            self._count -= 1
+            if self._count == 0:
+                self._owner = None
+                _REC.on_release(self.label)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked() if hasattr(self._inner, "locked") \
+            else self._owner is not None
+
+    def _is_owned(self) -> bool:        # Condition protocol
+        return self._owner == threading.get_ident()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return "<TsanLock %r owner=%s>" % (self.label, self._owner)
+
+
+def lock(label: str):
+    """A named mutex: plain ``threading.Lock`` when TSAN is off (zero
+    overhead), recording :class:`TsanLock` when on."""
+    return TsanLock(label) if TSAN else threading.Lock()
+
+
+def rlock(label: str):
+    return TsanLock(label, reentrant=True) if TSAN else threading.RLock()
+
+
+def condition(label: str):
+    """A named ``threading.Condition`` whose underlying lock is
+    instrumented when TSAN is on — ``wait()`` releases and re-acquires
+    through the wrapper, so the held set stays faithful."""
+    if not TSAN:
+        return threading.Condition()
+    return threading.Condition(TsanLock(label))
+
+
+# ----------------------------------------------------------------------
+# shared-state access notes
+def note_read(label: str, lockfree: bool = False, reason: str = "") -> None:
+    """Record "this thread read shared state ``label`` holding the
+    current lockset".  ``lockfree=True`` registers the state as
+    intentionally synchronized by other means (a ``queue.Queue``
+    handoff, an atomic-rename file protocol) — recorded for coverage,
+    exempt from the lockset rule; say why in ``reason``."""
+    if TSAN:
+        _REC.on_access("read", label, lockfree, reason)
+        _REC.maybe_flush()
+
+
+def note_write(label: str, lockfree: bool = False, reason: str = "") -> None:
+    if TSAN:
+        _REC.on_access("write", label, lockfree, reason)
+        _REC.maybe_flush()
+
+
+# ----------------------------------------------------------------------
+# snapshot / log plumbing
+def snapshot() -> dict:
+    """The current recorder's aggregates (plain data)."""
+    return _REC.snapshot()
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Flush the current recorder's event buffer (``path`` overrides
+    its log destination first)."""
+    if path is not None:
+        _REC.log_path = path
+    _REC.flush()
+    return _REC.log_path
+
+
+def flush_log() -> None:
+    _REC.flush()
+
+
+def parse_log(path: str) -> List[dict]:
+    """Events from a JSONL log.  Torn lines (a killed subprocess, an
+    interleaved multi-process append) are skipped, not fatal."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and "k" in ev and "o" in ev:
+                events.append(ev)
+    return events
+
+
+def replay(events: List[dict]) -> dict:
+    """Feed recorded events through a fresh aggregator and return its
+    snapshot — the cross-process half of the checker (the CI sweep
+    records under ``MXTPU_TSAN=1``; ``tools/concurrency_lint.py
+    --replay`` analyzes here)."""
+    rec = _Recorder()
+    for ev in events:
+        kind = ev.get("k")
+        label = ev.get("o", "")
+        thread = ev.get("t", "?")
+        held = list(ev.get("h") or [])
+        stack = ev.get("s", "")
+        if kind == "acq":
+            with rec._mu:
+                for h in held:
+                    if h != label:
+                        ex = rec.edges.setdefault((h, label), [])
+                        if len(ex) < _MAX_EXAMPLES:
+                            ex.append((thread, stack))
+        elif kind in ("read", "write"):
+            with rec._mu:
+                st = rec.states.setdefault(label, {
+                    "threads": set(), "writers": set(), "common": None,
+                    "lockfree": False, "reason": "", "examples": []})
+                st["threads"].add(thread)
+                if kind == "write":
+                    st["writers"].add(thread)
+                held_set = frozenset(held)
+                st["common"] = held_set if st["common"] is None \
+                    else st["common"] & held_set
+                if ev.get("lf"):
+                    st["lockfree"] = True
+                    if ev.get("why"):
+                        st["reason"] = ev["why"]
+                if len(st["examples"]) < _MAX_EXAMPLES:
+                    st["examples"].append(
+                        {"thread": thread, "kind": kind, "held": held,
+                         "stack": stack})
+    return rec.snapshot()
+
+
+if TSAN and _REC.log_path is not None:
+    atexit.register(flush_log)

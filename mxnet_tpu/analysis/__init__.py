@@ -20,10 +20,12 @@ CLI: ``tools/graph_lint.py`` (``--check`` gates CI against
 from .core import (ERROR, INFO, WARN, SEVERITIES, Annotation, Finding,
                    GraphLintWarning, GraphPass, GraphView, LintReport,
                    NodeView, PassContext, annotate, get_pass, list_passes,
-                   register_pass, run_passes)
+                   register_pass, render_reports, run_passes)
 from .lint import lint_json, lint_server, lint_symbol, lint_trainer
 from . import symbol_passes  # noqa: F401  registers the symbol passes
 from . import jaxpr_passes   # noqa: F401  registers the jaxpr passes
+from . import concurrency   # noqa: F401  registers source/runtime passes
+from .concurrency import lint_events, lint_runtime, lint_source, replay_log
 from .baseline import (BASELINE_PATH, baseline_entry, check_baseline,
                        load_baseline, write_baseline)
 
@@ -31,8 +33,10 @@ __all__ = [
     "ERROR", "WARN", "INFO", "SEVERITIES", "Annotation", "Finding",
     "GraphLintWarning", "GraphPass", "GraphView", "LintReport", "NodeView",
     "PassContext", "annotate", "get_pass", "list_passes", "register_pass",
-    "run_passes", "lint_symbol", "lint_json", "lint_trainer",
-    "lint_server",
+    "run_passes", "render_reports", "lint_symbol", "lint_json",
+    "lint_trainer",
+    "lint_server", "lint_source", "lint_runtime", "lint_events",
+    "replay_log",
     "BASELINE_PATH", "baseline_entry", "check_baseline", "load_baseline",
-    "write_baseline", "symbol_passes", "jaxpr_passes",
+    "write_baseline", "symbol_passes", "jaxpr_passes", "concurrency",
 ]

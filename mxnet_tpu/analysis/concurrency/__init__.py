@@ -1,0 +1,73 @@
+"""Concurrency sanitizer: runtime lockset/lock-order checking + static
+thread-safety lint over the host-side runtime.
+
+Three pieces, all emitting the graph linter's :class:`~..core.Finding`
+so reports, severity filtering, and the baseline ratchet are shared:
+
+* ``mxnet_tpu._tsan`` — the opt-in (``MXTPU_TSAN=1``) event recorder:
+  named instrumented locks, per-thread held-lock tracking, registered
+  shared-state access notes, a JSONL event log for cross-process
+  replay.  Zero instrumentation when the env var is unset.
+* :mod:`.lockset` — turns recorded events into ``lockset-race`` and
+  ``lock-order-inversion`` findings (level-``"runtime"`` passes).
+* :mod:`.static_pass` — AST rules over the source tree:
+  ``unnamed-thread`` / ``undeclared-daemon`` (error) and
+  ``unlocked-thread-mutation`` / ``blocking-call-under-lock`` (warn)
+  (level-``"source"`` pass).
+
+CLI + CI gate: ``tools/concurrency_lint.py`` (``--check`` ratchets
+against ``RACE_BASELINE.json``).  Docs:
+``docs/how_to/static_analysis.md``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ... import _tsan
+from ..core import LintReport, PassContext, run_passes
+from . import lockset, static_pass   # noqa: F401  — registers the passes
+from .lockset import analyze_snapshot, lock_order_findings, \
+    lockset_findings
+from .static_pass import default_root, scan_source
+
+__all__ = [
+    "lint_source", "lint_runtime", "lint_events", "replay_log",
+    "analyze_snapshot", "lockset_findings", "lock_order_findings",
+    "scan_source", "default_root", "lockset", "static_pass",
+]
+
+
+def lint_source(root: Optional[str] = None,
+                model: str = "concurrency-static") -> LintReport:
+    """The static thread-safety rules over ``root`` (default: the
+    ``mxnet_tpu`` package) as a :class:`LintReport`."""
+    ctx = PassContext(config={"source_root": root})
+    report = LintReport(model=model)
+    report.extend(run_passes(ctx, "source"))
+    report.traced = True
+    return report
+
+
+def lint_runtime(snapshot: Optional[dict] = None,
+                 model: str = "concurrency-runtime") -> LintReport:
+    """Lockset + lock-order findings over a recorder snapshot (default:
+    the live in-process recorder — i.e. what ``MXTPU_TSAN=1`` has seen
+    so far)."""
+    snapshot = snapshot if snapshot is not None else _tsan.snapshot()
+    ctx = PassContext(config={"tsan_snapshot": snapshot})
+    report = LintReport(model=model)
+    report.extend(run_passes(ctx, "runtime"))
+    report.traced = True
+    return report
+
+
+def lint_events(events: List[dict],
+                model: str = "concurrency-runtime") -> LintReport:
+    """Replay recorded events through a fresh aggregator and lint."""
+    return lint_runtime(_tsan.replay(events), model=model)
+
+
+def replay_log(path: str, model: str = "concurrency-runtime") -> LintReport:
+    """Parse a ``MXTPU_TSAN_LOG`` JSONL file and lint its events — the
+    cross-process half of the CI sweep."""
+    return lint_events(_tsan.parse_log(path), model=model)

@@ -1,0 +1,168 @@
+"""Lockset + lock-order analysis over recorded runtime events.
+
+The recording half lives in ``mxnet_tpu._tsan`` (enabled with
+``MXTPU_TSAN=1``); this module turns its aggregates into
+:class:`~..core.Finding`\\ s:
+
+* **lockset violation** (``lockset-race``, error) — the Eraser
+  discipline: shared state touched by two or more threads, at least
+  one write, and the intersection of the locksets held across all
+  accesses is empty.  States registered ``lockfree=True`` at the call
+  site (a ``queue.Queue`` handoff, an atomic-rename file protocol) are
+  recorded for coverage but exempt.
+* **lock-order inversion** (``lock-order-inversion``, error) — a cycle
+  in the lock acquisition graph (edge ``A -> B`` = some thread acquired
+  ``B`` while holding ``A``): two threads taking the cycle's locks in
+  different orders can deadlock.  Each edge carries the first threads
+  and stacks observed taking it.
+
+Both run as registered :class:`~..core.GraphPass`\\ es at level
+``"runtime"`` so the baseline ratchet, severity filtering, and report
+format are shared with the graph linter (``RACE_BASELINE.json`` /
+``tools/concurrency_lint.py``).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..core import (ERROR, Finding, GraphPass, PassContext, register_pass)
+
+__all__ = ["lockset_findings", "lock_order_findings", "analyze_snapshot"]
+
+
+def _fmt_example(ex: dict) -> str:
+    held = "{%s}" % ", ".join(ex["held"]) if ex["held"] else "{}"
+    return "%s %s under %s at %s" % (ex["thread"], ex["kind"], held,
+                                     ex["stack"] or "<no stack>")
+
+
+def lockset_findings(snapshot: dict) -> List[Finding]:
+    """Empty-common-lockset violations over the recorded shared-state
+    accesses."""
+    findings = []
+    for label in sorted(snapshot.get("states", {})):
+        st = snapshot["states"][label]
+        threads, writers = st["threads"], st["writers"]
+        if len(threads) < 2 or not writers:
+            continue        # single-threaded, or read-only sharing
+        if st.get("lockfree"):
+            continue        # synchronized by other means (registered)
+        if st.get("common"):
+            continue        # a common lock protects every access
+        detail = {
+            "threads": ", ".join(threads),
+            "writer_threads": ", ".join(writers),
+        }
+        for i, ex in enumerate(st.get("examples", [])):
+            detail["access_%d" % i] = _fmt_example(ex)
+        findings.append(Finding(
+            "lockset-race", ERROR, label, "<runtime>",
+            "shared state %r is written from threads [%s] with NO common "
+            "lock across its accesses (empty lockset intersection) — a "
+            "torn read/lost update is possible; hold one named lock at "
+            "every access, or register the state lockfree with the "
+            "synchronization story spelled out"
+            % (label, ", ".join(writers)), detail=detail))
+    return findings
+
+
+def _edges(snapshot: dict) -> Dict[Tuple[str, str], list]:
+    out = {}
+    for key, examples in snapshot.get("edges", {}).items():
+        a, _, b = key.partition("\x00")
+        out[(a, b)] = examples
+    return out
+
+
+def _bfs_path(adj: Dict[str, set], src: str, dst: str) -> Optional[list]:
+    """Shortest ``src -> ... -> dst`` node path, or None."""
+    if src == dst:
+        return [src]
+    prev = {src: None}
+    frontier = [src]
+    while frontier:
+        nxt = []
+        for n in frontier:
+            for m in sorted(adj.get(n, ())):
+                if m in prev:
+                    continue
+                prev[m] = n
+                if m == dst:
+                    path = [m]
+                    while prev[path[-1]] is not None:
+                        path.append(prev[path[-1]])
+                    return list(reversed(path))
+                nxt.append(m)
+        frontier = nxt
+    return None
+
+
+def lock_order_findings(snapshot: dict) -> List[Finding]:
+    """Cycles in the acquisition graph, one finding per distinct cycle
+    node-set, with per-edge thread/stack provenance."""
+    edges = _edges(snapshot)
+    adj: Dict[str, set] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    findings, seen = [], set()
+    for a in sorted(adj):
+        for b in sorted(adj[a]):
+            back = _bfs_path(adj, b, a)
+            if back is None:
+                continue
+            cycle = [a] + back          # a -> b -> ... -> a
+            key = frozenset(cycle)
+            if key in seen:
+                continue
+            seen.add(key)
+            detail = {"cycle": " -> ".join(cycle)}
+            threads = set()
+            for x, y in zip(cycle, cycle[1:]):
+                for thread, stack in edges.get((x, y), [])[:2]:
+                    threads.add(thread)
+                    detail.setdefault(
+                        "edge %s->%s" % (x, y),
+                        "%s at %s" % (thread, stack or "<no stack>"))
+            findings.append(Finding(
+                "lock-order-inversion", ERROR, " -> ".join(cycle),
+                "<runtime>",
+                "locks acquired in conflicting orders by threads [%s]: "
+                "%s — two threads interleaving these orders can "
+                "deadlock; pick one global order for this lock set"
+                % (", ".join(sorted(threads)), " -> ".join(cycle)),
+                detail=detail))
+    return findings
+
+
+def analyze_snapshot(snapshot: dict) -> List[Finding]:
+    """Both rule families over one recorder snapshot."""
+    return lockset_findings(snapshot) + lock_order_findings(snapshot)
+
+
+# ----------------------------------------------------------------------
+@register_pass
+class RuntimeLocksetPass(GraphPass):
+    """Empty-lockset shared-state races over the recorded events
+    (``ctx.config["tsan_snapshot"]``)."""
+
+    name = "runtime-lockset"
+    level = "runtime"
+    doc = "shared mutable state accessed under an empty common lockset"
+
+    def run(self, ctx: PassContext):
+        snap = ctx.config.get("tsan_snapshot")
+        return lockset_findings(snap) if snap else []
+
+
+@register_pass
+class RuntimeLockOrderPass(GraphPass):
+    """Acquisition-graph cycles (potential deadlocks) over the recorded
+    events."""
+
+    name = "runtime-lock-order"
+    level = "runtime"
+    doc = "cycles in the lock acquisition graph"
+
+    def run(self, ctx: PassContext):
+        snap = ctx.config.get("tsan_snapshot")
+        return lock_order_findings(snap) if snap else []

@@ -1,0 +1,300 @@
+"""Static thread-safety lint over the ``mxnet_tpu`` source tree.
+
+The runtime lockset checker (``lockset.py``) only sees what a test run
+exercises; this AST pass sees every line.  Rules (catalog + fix recipes
+in ``docs/how_to/static_analysis.md``):
+
+* ``unnamed-thread`` (error) — a ``threading.Thread(...)`` spawn with
+  no ``name=``.  Every framework thread carries an ``mxtpu-*`` name so
+  sanitizer findings, leak checks (``tests/conftest.py``), and stack
+  dumps say *which* subsystem's thread is involved.
+* ``undeclared-daemon`` (error) — a spawn with no explicit ``daemon=``:
+  whether a thread may outlive the interpreter's shutdown is a policy
+  decision, not a default to inherit silently.
+* ``unlocked-thread-mutation`` (warn) — a method reachable from a
+  ``Thread(target=self.X)`` spawn assigns an attribute that
+  ``__init__`` also assigns, outside any ``with self.<lock>`` block:
+  the consumer thread can observe a torn update.  Suppress a
+  deliberate site with a ``# tsan: ok`` line comment *and* a reason.
+* ``blocking-call-under-lock`` (warn) — ``join``/``sleep``/``fsync``/
+  ``device_put``/``block_until_ready``/``open`` called while a lock-ish
+  ``with`` is held: the lock's other critical sections stall for the
+  full blocking duration (the classic serving-p99 long pole).
+
+"Lock-ish" is name-based (``lock``/``cond``/``mutex``/``mu``/``cv`` in
+the attribute), matching this repo's naming convention — the runtime
+checker, which sees real acquisitions, is the ground truth; this pass
+is the cheap always-on screen.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Set
+
+from ..core import ERROR, WARN, Finding, GraphPass, PassContext, \
+    register_pass
+
+__all__ = ["scan_source", "default_root"]
+
+_LOCKISH = re.compile(r"lock|cond|mutex|(^|_)mu$|(^|_)cv$", re.I)
+_BLOCKING_ATTRS = {"join", "sleep", "fsync", "device_put",
+                   "block_until_ready"}
+_SUPPRESS = "tsan: ok"
+
+
+def default_root() -> str:
+    """The ``mxnet_tpu`` package directory."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _is_lockish_expr(expr) -> bool:
+    """``self._lock`` / ``self._cond`` / bare ``_CACHE_LOCK`` — the
+    name the lock travels under decides."""
+    if isinstance(expr, ast.Attribute):
+        return bool(_LOCKISH.search(expr.attr))
+    if isinstance(expr, ast.Name):
+        return bool(_LOCKISH.search(expr.id))
+    if isinstance(expr, ast.Call):
+        # with self._lock.acquire_timeout(...) style
+        return _is_lockish_expr(expr.func.value) \
+            if isinstance(expr.func, ast.Attribute) else False
+    return False
+
+
+def _self_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _expr_nodes(node) -> Iterable[ast.AST]:
+    """Every expression node under ``node`` WITHOUT descending into
+    nested statements (those get their own locked-state visit)."""
+    for ch in ast.iter_child_nodes(node):
+        if isinstance(ch, (ast.stmt,)):
+            continue
+        yield ch
+        yield from _expr_nodes(ch)
+
+
+def _stmts_with_lockstate(stmts, locked: bool):
+    """Flat ``(statement, locked)`` pairs; ``with self.<lockish>:``
+    bodies are locked.  Nested function/class definitions are skipped —
+    their bodies execute later, not under this lock."""
+    for st in stmts:
+        yield st, locked
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            continue
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            inner = locked or any(_is_lockish_expr(i.context_expr)
+                                  for i in st.items)
+            yield from _stmts_with_lockstate(st.body, inner)
+            continue
+        for field in ("body", "orelse", "finalbody"):
+            sub = getattr(st, field, None)
+            if sub:
+                yield from _stmts_with_lockstate(sub, locked)
+        for h in getattr(st, "handlers", None) or ():
+            yield from _stmts_with_lockstate(h.body, locked)
+
+
+class _ClassInfo:
+    __slots__ = ("name", "init_attrs", "methods", "calls", "targets")
+
+    def __init__(self, name):
+        self.name = name
+        self.init_attrs: Set[str] = set()
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.calls: Dict[str, Set[str]] = {}
+        self.targets: Set[str] = set()
+
+    def thread_methods(self) -> Set[str]:
+        """Transitive closure of thread-target methods over same-class
+        ``self.m()`` calls."""
+        out, frontier = set(), list(self.targets)
+        while frontier:
+            m = frontier.pop()
+            if m in out or m not in self.methods:
+                continue
+            out.add(m)
+            frontier.extend(self.calls.get(m, ()))
+        return out
+
+
+def _is_thread_call(call: ast.Call) -> bool:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return name == "Thread"
+
+
+def _scan_class(cls: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(cls.name)
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        info.methods[item.name] = item
+        called: Set[str] = set()
+        for node in ast.walk(item):
+            if isinstance(node, ast.Call):
+                attr = _self_attr(node.func)
+                if attr:
+                    called.add(attr)
+                if _is_thread_call(node):
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            t = _self_attr(kw.value)
+                            if t:
+                                info.targets.add(t)
+            if item.name == "__init__" and \
+                    isinstance(node, (ast.Assign, ast.AugAssign,
+                                      ast.AnnAssign)):
+                tgts = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in tgts:
+                    attr = _self_attr(t)
+                    if attr:
+                        info.init_attrs.add(attr)
+        info.calls[item.name] = called
+    return info
+
+
+def _mutated_attr(st) -> Optional[str]:
+    """The ``self.X`` (or ``self.X[...]``) a statement assigns, if any."""
+    if isinstance(st, (ast.Assign, ast.AugAssign)):
+        tgts = st.targets if isinstance(st, ast.Assign) else [st.target]
+        for t in tgts:
+            attr = _self_attr(t)
+            if attr:
+                return attr
+            if isinstance(t, ast.Subscript):
+                attr = _self_attr(t.value)
+                if attr:
+                    return attr
+    return None
+
+
+def _scan_file(path: str, rel: str) -> List[Finding]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Finding("source-parse", ERROR, rel, "<source>",
+                        "could not parse: %s" % e)]
+    lines = src.splitlines()
+    # a '# tsan: ok <why>' marker suppresses findings on its own line
+    # AND the following one (the reason usually wants a full line)
+    marked = {i + 1 for i, line in enumerate(lines) if _SUPPRESS in line}
+    suppressed = marked | {i + 1 for i in marked}
+
+    def where(node) -> str:
+        return "%s:%d" % (rel, node.lineno)
+
+    findings: List[Finding] = []
+
+    # ---- thread-spawn policy (anywhere in the file)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+            continue
+        if node.lineno in suppressed:
+            continue
+        kw = {k.arg for k in node.keywords}
+        if None in kw:
+            continue        # **kwargs: can't reason statically
+        if "name" not in kw:
+            findings.append(Finding(
+                "unnamed-thread", ERROR, where(node), "Thread",
+                "thread spawned without name= — give it an mxtpu-* name "
+                "so sanitizer findings, the conftest leak check, and "
+                "stack dumps identify the subsystem"))
+        if "daemon" not in kw:
+            findings.append(Finding(
+                "undeclared-daemon", ERROR, where(node), "Thread",
+                "thread spawned without an explicit daemon= policy — "
+                "decide whether it may outlive interpreter shutdown"))
+
+    # ---- per-class rules
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        info = _scan_class(node)
+        hot = info.thread_methods()
+        for mname, meth in info.methods.items():
+            op = "%s.%s" % (info.name, mname)
+            in_thread = mname in hot
+            for st, locked in _stmts_with_lockstate(meth.body, False):
+                if st.lineno in suppressed:
+                    continue
+                if in_thread and not locked and mname != "__init__":
+                    attr = _mutated_attr(st)
+                    if attr and attr in info.init_attrs \
+                            and not _LOCKISH.search(attr):
+                        findings.append(Finding(
+                            "unlocked-thread-mutation", WARN, where(st),
+                            op,
+                            "self.%s is mutated from thread-target-"
+                            "reachable %s without an enclosing "
+                            "'with self.<lock>' (it is also assigned in "
+                            "__init__, so another thread can observe a "
+                            "torn update); lock it, or mark the line "
+                            "'# tsan: ok <why>'" % (attr, op),
+                            detail={"attr": attr}))
+                if locked:
+                    for sub in _expr_nodes(st):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        f = sub.func
+                        blocked = None
+                        if isinstance(f, ast.Attribute) \
+                                and f.attr in _BLOCKING_ATTRS:
+                            blocked = f.attr
+                        elif isinstance(f, ast.Name) and f.id == "open":
+                            blocked = "open"
+                        if blocked and sub.lineno not in suppressed:
+                            findings.append(Finding(
+                                "blocking-call-under-lock", WARN,
+                                "%s:%d" % (rel, sub.lineno), op,
+                                "%s() while holding a lock: every other "
+                                "critical section of that lock stalls "
+                                "for the full blocking duration — move "
+                                "the call outside, or mark "
+                                "'# tsan: ok <why>'" % blocked,
+                                detail={"call": blocked}))
+    return findings
+
+
+def scan_source(root: Optional[str] = None) -> List[Finding]:
+    """All rules over every ``*.py`` under ``root`` (default: the
+    installed ``mxnet_tpu`` package)."""
+    root = root or default_root()
+    findings: List[Finding] = []
+    base = os.path.dirname(os.path.abspath(root.rstrip(os.sep)))
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base)
+            findings.extend(_scan_file(path, rel))
+    return findings
+
+
+# ----------------------------------------------------------------------
+@register_pass
+class SourceConcurrencyPass(GraphPass):
+    """The static thread-safety rules over ``config["source_root"]``."""
+
+    name = "source-concurrency"
+    level = "source"
+    doc = "AST thread-safety lint (spawn policy, unlocked mutation, " \
+          "blocking under lock)"
+
+    def run(self, ctx: PassContext):
+        return scan_source(ctx.config.get("source_root"))

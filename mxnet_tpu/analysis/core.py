@@ -32,6 +32,7 @@ __all__ = [
     "ERROR", "WARN", "INFO", "SEVERITIES", "Finding", "GraphLintWarning",
     "NodeView", "GraphView", "annotate", "GraphPass", "PassContext",
     "LintReport", "register_pass", "get_pass", "list_passes", "run_passes",
+    "render_reports",
 ]
 
 ERROR = "error"
@@ -75,6 +76,15 @@ class Finding:
             where = "%s@%s" % (self.node, self.layer)
         return "[%s] %-22s %s(%s): %s" % (
             self.severity.upper(), self.rule, where, self.op, self.message)
+
+    def dedupe_key(self) -> str:
+        """Stable identity for one finding across runs and sources:
+        ``rule|node|op|layer`` — deliberately EXCLUDES the message (its
+        wording carries volatile values — ages, counts, thread idents)
+        so graph and concurrency findings dedupe on what they flag, not
+        on how they phrase it."""
+        return "%s|%s|%s|%s" % (self.rule, self.node, self.op,
+                                self.layer or "")
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"rule": self.rule, "severity": self.severity, "node": self.node,
@@ -457,6 +467,35 @@ class LintReport:
         self.findings.extend(findings)
         return self
 
+    def dedupe(self) -> "LintReport":
+        """Drop findings whose :meth:`Finding.dedupe_key` repeats,
+        keeping the first (stable order) — one report line per distinct
+        hazard site regardless of how many passes or replays saw it."""
+        seen, kept = set(), []
+        for f in self.findings:
+            k = f.dedupe_key()
+            if k in seen:
+                continue
+            seen.add(k)
+            kept.append(f)
+        self.findings = kept
+        return self
+
+    def filter_severity(self, min_severity: Optional[str]) -> "LintReport":
+        """Keep findings at or above ``min_severity`` (``None`` keeps
+        all) — the ``--severity`` CLI filter, shared by graph and
+        concurrency reports."""
+        if min_severity is None:
+            return self
+        if min_severity not in SEVERITIES:
+            raise MXNetError("severity must be one of %s, got %r"
+                             % (SEVERITIES, min_severity))
+        order = {s: i for i, s in enumerate(SEVERITIES)}
+        cut = order[min_severity]
+        self.findings = [f for f in self.findings
+                         if order[f.severity] <= cut]
+        return self
+
     def counts(self) -> Dict[str, int]:
         c = {s: 0 for s in SEVERITIES}
         for f in self.findings:
@@ -495,3 +534,20 @@ class LintReport:
                 "warns_by_rule": self.by_rule(WARN),
                 "infos_by_rule": self.by_rule(INFO),
                 "findings": [f.to_dict() for f in self.findings]}
+
+
+def render_reports(reports: Dict[str, "LintReport"],
+                   severity: Optional[str] = None, as_json: bool = False,
+                   max_findings: int = 25) -> str:
+    """The CLIs' shared output block (``tools/graph_lint.py`` and
+    ``tools/concurrency_lint.py``): severity-filter DISPLAY COPIES —
+    never the reports a baseline gate will judge or record — and render
+    them as summaries or one JSON object."""
+    import copy
+    shown = {n: copy.copy(r).filter_severity(severity)
+             for n, r in reports.items()}
+    if as_json:
+        return json.dumps({n: shown[n].to_dict() for n in sorted(shown)},
+                          indent=1)
+    return "\n".join(shown[n].summary(max_findings=max_findings)
+                     for n in sorted(shown))

@@ -267,7 +267,7 @@ def lint_server(server, config: Optional[Dict[str, Any]] = None,
     # sharing one compiled forward are reported as one joined entry so
     # a shared stall isn't double-counted.
     lint_cfg.setdefault("serve_batch_sizes", {
-        "+".join(names): list(cf.lazy_batch_sizes)
+        "+".join(names): cf.counts()["lazy_batch_sizes"]
         for cf, names in server._cf_groups()})
     report = LintReport(model="serving")
     ctx = PassContext(jaxpr=None, is_train=False, config=lint_cfg)

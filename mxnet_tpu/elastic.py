@@ -52,6 +52,7 @@ import time
 from typing import List, Optional
 
 from .base import MXNetError
+from . import _tsan
 from . import faults as _faults
 from . import health as _health
 from .parallel.collectives import _process_count, _process_index
@@ -105,6 +106,11 @@ class Membership:
 def read_membership(directory: str, num_workers: int) -> Membership:
     """The current membership record; epoch 1 over all ranks when none
     has been published (the implicit founding epoch)."""
+    if _tsan.TSAN:
+        _tsan.note_read(
+            "elastic.membership_record", lockfree=True,
+            reason="atomic tmp+rename commit; readers see a whole "
+                   "record or the previous one, never a torn write")
     try:
         with open(membership_path(directory)) as f:
             raw = json.load(f)
@@ -122,6 +128,11 @@ def _write_membership(directory: str, mem: Membership) -> None:
     tmp+rename recipe as the checkpoint manifests (``model._commit_file``
     is not reused verbatim: a fixed ``.tmp`` name would let two racing
     publishers tear each other; the pid-suffixed tmp cannot)."""
+    if _tsan.TSAN:
+        _tsan.note_write(
+            "elastic.membership_record", lockfree=True,
+            reason="atomic tmp+rename commit; readers see a whole "
+                   "record or the previous one, never a torn write")
     path = membership_path(directory)
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:

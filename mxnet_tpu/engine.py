@@ -24,6 +24,7 @@ from typing import Optional, Sequence
 
 __all__ = ["Engine", "Var", "get", "set_engine_type"]
 
+from . import _tsan
 from ._native import FN_T as _FN_T, lib as _lib
 
 
@@ -71,12 +72,14 @@ class Engine:
         # (Freeing a per-op CFUNCTYPE from inside its own invocation would
         # free the libffi closure still on the C stack.)
         self._fns = {}
-        self._ka_lock = threading.Lock()
+        self._ka_lock = _tsan.lock("engine.Engine._ka_lock")
         self._seq = 0
         self._exc = None  # first op failure; re-raised at the next sync point
 
         def _dispatch(argp):
             with self._ka_lock:
+                if _tsan.TSAN:
+                    _tsan.note_write("engine.Engine._fns")
                 fn = self._fns.pop(argp, None)
             if fn is not None:
                 try:
@@ -87,6 +90,8 @@ class Engine:
                     # engine's on_complete error path rather than losing it
                     # to the unraisable hook
                     with self._ka_lock:
+                        if _tsan.TSAN:
+                            _tsan.note_write("engine.Engine._exc")
                         if self._exc is None:
                             self._exc = e
 
@@ -99,6 +104,8 @@ class Engine:
     def push(self, fn, const_vars: Sequence[Var] = (),
              mutable_vars: Sequence[Var] = (), priority: int = 0):
         with self._ka_lock:
+            if _tsan.TSAN:
+                _tsan.note_write("engine.Engine._fns")
             self._seq += 1
             seq = self._seq
             self._fns[seq] = fn
@@ -126,6 +133,8 @@ class Engine:
 
     def _raise_pending(self):
         with self._ka_lock:
+            if _tsan.TSAN:
+                _tsan.note_write("engine.Engine._exc")
             exc, self._exc = self._exc, None
         if exc is not None:
             raise exc
@@ -164,7 +173,8 @@ def _flush_at_exit():
     Bounded: a wedged op (blocking data source) must not hang exit."""
     if _DEFAULT is not None:
         try:
-            waiter = threading.Thread(target=_DEFAULT.wait_all, daemon=True)
+            waiter = threading.Thread(target=_DEFAULT.wait_all, daemon=True,
+                                      name="mxtpu-engine-drain")
             waiter.start()
             waiter.join(timeout=10.0)
         except Exception:

@@ -69,9 +69,9 @@ Example::
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, List, Optional
 
+from . import _tsan
 from .base import MXNetError
 
 __all__ = ["configure", "clear", "active", "hit", "maybe_crash",
@@ -159,7 +159,7 @@ def _parse(spec: str) -> List[_Directive]:
     return out
 
 
-_lock = threading.Lock()
+_lock = _tsan.lock("faults._lock")
 _directives: List[_Directive] = []
 _configured = False        # explicit configure() beats the env
 _ACTIVE = False            # lock-free fast-path flag for hot sites

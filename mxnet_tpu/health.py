@@ -40,6 +40,7 @@ import time
 import weakref
 from typing import Dict, List, Optional
 
+from . import _tsan
 from . import faults as _faults
 
 __all__ = ["Heartbeat", "dead_nodes", "rank_evidence", "heartbeat_dir"]
@@ -107,7 +108,8 @@ class Heartbeat:
                 self._beat()
             except Exception:              # noqa: BLE001
                 pass
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="mxtpu-hb-%d" % rank)
             self._thread.start()
             _live_beats.add(self)
 
@@ -123,7 +125,10 @@ class Heartbeat:
         return self._stalled
 
     def _beat(self):
-        self._beats += 1
+        # __init__ calls _beat once BEFORE Thread.start() (a happens-
+        # before edge); afterwards only the beat thread runs it, so the
+        # counter is single-writer
+        self._beats += 1   # tsan: ok — ordered before Thread.start()
         if _faults.hit("hb_stall", site="hb_stamp", beat=self._beats,
                        rank=self.rank):
             # the split-brain fault: the stamper freezes but the process
@@ -131,7 +136,9 @@ class Heartbeat:
             # contract) declare this rank dead; mxnet_tpu.elastic makes
             # the declared-dead-but-alive rank exit cleanly when it
             # observes its own revocation
-            self._stalled = True
+            self._stalled = True   # tsan: ok — monotonic one-way flag,
+            #                        single-writer (the beat thread);
+            #                        readers tolerate any staleness
         if self._stalled:
             return
         if _faults.hit("io_error", site="hb_stamp", beat=self._beats):
@@ -143,6 +150,11 @@ class Heartbeat:
         # and first observations working
         stamp = "%f %d" % (time.time(), self._beats)
         if self.directory:
+            if _tsan.TSAN:
+                _tsan.note_write(
+                    "health.heartbeat_stamp", lockfree=True,
+                    reason="single-writer stamp file; scanners tolerate "
+                           "torn reads via mtime (liveness contract)")
             with open(_stamp_path(self.directory, self.rank), "w") as f:
                 f.write(stamp + "\n")
         if self._kv is not None:
@@ -184,6 +196,11 @@ def _file_stamps(directory: str, num_workers: int) -> dict:
     one that cannot be opened still counts through its mtime — a rank
     must never be declared dead because the SCANNER hit a torn read;
     only a stamp with no readable evidence at all is skipped."""
+    if _tsan.TSAN:
+        _tsan.note_read(
+            "health.heartbeat_stamp", lockfree=True,
+            reason="single-writer stamp file; scanners tolerate torn "
+                   "reads via mtime (liveness contract)")
     out = {}
     for rank in range(num_workers):
         path = _stamp_path(directory, rank)
@@ -228,13 +245,15 @@ def _kv_stamps(client) -> dict:
 # of the stamp AT that first sight — the baseline that keeps a stale
 # file discovered mid-life from reading as "fresh for one timeout").
 # Guarded by a lock: dead_nodes may be called from monitor threads.
-_seq_lock = threading.Lock()
+_seq_lock = _tsan.lock("health._seq_lock")
 _seq_track: Dict[tuple, tuple] = {}
 
 
 def _reset_seq_cache():
     """Forget all sequence-progress history (tests)."""
     with _seq_lock:
+        if _tsan.TSAN:
+            _tsan.note_write("health._seq_track")
         _seq_track.clear()
 
 
@@ -250,6 +269,8 @@ def _evidence_age(key, rank, wall, seq, now_wall, now_mono):
     if seq is not None:
         wall_age = max(0.0, now_wall - wall) if wall is not None else 0.0
         with _seq_lock:
+            if _tsan.TSAN:
+                _tsan.note_write("health._seq_track")
             prev = _seq_track.get((key, rank))
             if prev is None or prev[0] != seq:
                 # advanced since the previous scan: fresh — but only

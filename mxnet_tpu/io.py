@@ -30,6 +30,7 @@ import numpy as np
 
 from .base import MXNetError, mx_real_t, _dtype
 from .ndarray import NDArray, array
+from . import _tsan
 from . import faults as _faults
 from . import ndarray as nd
 from . import recordio as _recordio
@@ -280,7 +281,7 @@ class PrefetchingIter(_CurrentBatchAccessors, DataIter):
             if self._engine is None or not any(self._scheduled):
                 return
             t = threading.Thread(target=lambda: self._drain(reraise=False),
-                                 daemon=True)
+                                 daemon=True, name="mxtpu-prefetch-drain")
             t.start()
             t.join(timeout=1.0)
         except Exception:
@@ -416,6 +417,11 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         self._q = queue.Queue(self._depth)
         self._stop = threading.Event()
         self._err = None
+        # stage-attribution counters are written by BOTH sides of the
+        # pipeline (worker: upload/source wall; consumer: wait/hit
+        # tallies) and read whole by stats() — one lock, one snapshot,
+        # no mid-update reads (the lockset checker gates this)
+        self._stats_lock = _tsan.lock("io.DeviceUploadIter._stats_lock")
         self.upload_s = 0.0
         self.source_s = 0.0
         self.consumer_wait_s = 0.0
@@ -440,7 +446,8 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
     # ------------------------------------------------------------------
     def _start_worker(self):
         self._stop.clear()
-        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker = threading.Thread(target=self._run, daemon=True,
+                                        name="mxtpu-upload")
         self._worker.start()
 
     def _run(self):
@@ -454,7 +461,7 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
                 except StopIteration:
                     self._put(self._END)
                     return
-                self.source_s += _time.perf_counter() - t0
+                dt_src = _time.perf_counter() - t0
                 t0 = _time.perf_counter()
                 # resolve callable shardings lazily, once per batch
                 data_sh = self._data_shardings() \
@@ -468,8 +475,12 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
                 label = [self._upload(a, label_sh, i)
                          for i, a in enumerate(b.label or [])]
                 jax.block_until_ready([a.data for a in data + label])
-                self.upload_s += _time.perf_counter() - t0
-                self.batches_staged += 1
+                with self._stats_lock:
+                    if _tsan.TSAN:
+                        _tsan.note_write("io.DeviceUploadIter.stats")
+                    self.source_s += dt_src
+                    self.upload_s += _time.perf_counter() - t0
+                    self.batches_staged += 1
                 staged = DataBatch(data=data, label=label, pad=b.pad,
                                    index=b.index,
                                    provide_data=b.provide_data,
@@ -477,7 +488,10 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
                 if not self._put(staged):
                     return
         except Exception as e:              # surface in the consumer
-            self._err = e
+            self._err = e   # tsan: ok — published BEFORE the _END
+            #                 sentinel; the consumer reads it only after
+            #                 draining the queue (a happens-before edge
+            #                 through queue.Queue's internal lock)
             self._put(self._END)
 
     def _upload(self, a, shardings, i):
@@ -515,6 +529,9 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
             return False
 
     def _put(self, item):
+        if _tsan.TSAN:
+            _tsan.note_write("io.DeviceUploadIter.staging", lockfree=True,
+                             reason="queue.Queue handoff (internal lock)")
         while not self._stop.is_set():
             try:
                 self._q.put(item, timeout=0.1)
@@ -557,12 +574,20 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         if self._worker is None or not (self._worker.is_alive()
                                         or self._q.qsize()):
             self._start_worker()
-        self._next_calls += 1
-        if self._q.qsize():
-            self._ready_hits += 1       # staged ahead of the ask
+        ready = bool(self._q.qsize())   # staged ahead of the ask
         t0 = _time.perf_counter()
+        if _tsan.TSAN:
+            _tsan.note_read("io.DeviceUploadIter.staging", lockfree=True,
+                            reason="queue.Queue handoff (internal lock)")
         item = self._q.get()
-        self.consumer_wait_s += _time.perf_counter() - t0
+        dt_wait = _time.perf_counter() - t0
+        with self._stats_lock:
+            if _tsan.TSAN:
+                _tsan.note_write("io.DeviceUploadIter.stats")
+            self._next_calls += 1
+            if ready:
+                self._ready_hits += 1
+            self.consumer_wait_s += dt_wait
         if item is self._END:
             self._ended = True
             if self._err is not None:
@@ -586,15 +611,26 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         side: ``consumer_wait_s`` (blocked on the staging queue) and
         ``ready_ahead_frac`` (fraction of ``next()`` calls served from
         an already-staged batch — 1.0 means the pipeline ran fully
-        ahead of consumption)."""
-        return {"upload_s": round(self.upload_s, 3),
-                "source_s": round(self.source_s, 3),
-                "decode_wait_s": round(self.source_s, 3),
-                "consumer_wait_s": round(self.consumer_wait_s, 3),
-                "ready_ahead_frac": round(
-                    self._ready_hits / self._next_calls, 3)
-                if self._next_calls else None,
-                "batches_staged": self.batches_staged,
+        ahead of consumption).
+
+        One atomic snapshot under the stats lock: the worker updates
+        these counters mid-flight, and an unlocked read could pair a
+        fresh ``upload_s`` with a stale ``batches_staged`` (the race
+        the concurrency sanitizer flags)."""
+        with self._stats_lock:
+            if _tsan.TSAN:
+                _tsan.note_read("io.DeviceUploadIter.stats")
+            upload_s, source_s = self.upload_s, self.source_s
+            consumer_wait_s = self.consumer_wait_s
+            staged = self.batches_staged
+            hits, calls = self._ready_hits, self._next_calls
+        return {"upload_s": round(upload_s, 3),
+                "source_s": round(source_s, 3),
+                "decode_wait_s": round(source_s, 3),
+                "consumer_wait_s": round(consumer_wait_s, 3),
+                "ready_ahead_frac": round(hits / calls, 3)
+                if calls else None,
+                "batches_staged": staged,
                 "chunks": self._chunks,
                 "depth": self._depth}
 
@@ -1557,19 +1593,25 @@ class PyImageRecordIter(DataIter):
             return
         self._drain()
         self._stop.clear()
-        self._producer = threading.Thread(target=self._produce, daemon=True)
+        self._producer = threading.Thread(target=self._produce, daemon=True,
+                                          name="mxtpu-decode")
         self._producer.start()
 
     def close(self):
-        """Tear down the process-mode decode ring (worker processes +
-        shared-memory slabs).  Idempotent; also runs at GC.  Thread
-        mode needs no explicit teardown (its daemon producer dies with
-        the process — joining it from a GC-time finalizer risks the
-        CPython-3.10 shutdown stall the PrefetchingIter ``__del__``
-        note describes)."""
+        """Tear down the decode pipeline: the process-mode ring (worker
+        processes + shared-memory slabs) AND the thread-mode producer.
+        Idempotent; also runs at GC for the ring.  The thread producer
+        is stopped here because a mid-epoch abandon used to leave it
+        parked in its bounded-put loop until process exit — the
+        ``mxtpu-decode`` thread held a reference to this iterator (its
+        bound ``_produce``), so GC never fired and the thread leaked
+        (the conftest ``mxtpu-*`` leak check catches exactly this)."""
         if self._ring is not None:
             self._ring.close()
             self._ring = None
+        if self._producer is not None and \
+                self._producer is not threading.current_thread():
+            self._drain()
 
     def __del__(self):
         try:

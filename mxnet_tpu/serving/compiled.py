@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..executor import _GraphProgram
+from .. import _tsan
 
 __all__ = ["CompiledForward", "compiled_forward", "cache_stats",
            "clear_cache", "infer_input_dtypes"]
@@ -102,7 +103,7 @@ class CompiledForward:
         self.lazy_batch_sizes: List[int] = []
         self._aot_keys: set = set()     # signatures compiled at startup
         self._aot_tls = threading.local()
-        self._lock = threading.Lock()
+        self._lock = _tsan.lock("serving.CompiledForward._lock")
         # eval-mode RNG: one constant key.  Serving is deterministic by
         # contract — a model whose eval forward draws (sampling heads)
         # gets the same stream every call; per-call keys would make the
@@ -120,6 +121,8 @@ class CompiledForward:
             # the trace on the calling thread, so a concurrent lazy
             # trace on another thread is still attributed correctly.
             with self._lock:
+                if _tsan.TSAN:
+                    _tsan.note_write("serving.CompiledForward.counters")
                 self.trace_count += 1
                 b = self._batch_dim(batch)
                 self.traced_batch_sizes.append(b)
@@ -166,8 +169,9 @@ class CompiledForward:
             sharding=batch_shardings.get(n))
             for n, s in batch_shapes.items()}
         key = self._sig(sds)
-        if key in self._aot_keys:
-            return
+        with self._lock:
+            if key in self._aot_keys:
+                return
 
         def _wsds(v):
             sh = getattr(v, "sharding", None)
@@ -185,7 +189,10 @@ class CompiledForward:
             self._jit.lower(p_sds, a_sds, sds, self._rng).compile()
         finally:
             self._aot_tls.active = False
-        self._aot_keys.add(key)
+        with self._lock:
+            if _tsan.TSAN:
+                _tsan.note_write("serving.CompiledForward.counters")
+            self._aot_keys.add(key)
 
     def run(self, params, aux, batch: Dict) -> Tuple:
         """Execute the forward.  ``batch`` maps every input name to a
@@ -194,29 +201,43 @@ class CompiledForward:
         return self._jit(params, aux, batch, self._rng)
 
     # ------------------------------------------------------------------
+    def counts(self) -> Dict:
+        """One atomic snapshot of the trace accounting — traces, AOT
+        signatures, retraces, and the lazily-traced batch sizes — taken
+        under the counter lock so a concurrent trace on another thread
+        can never be read mid-update (``ModelServer.stats`` and the
+        lint path both consume this)."""
+        with self._lock:
+            if _tsan.TSAN:
+                _tsan.note_read("serving.CompiledForward.counters")
+            return {"traces": self.trace_count,
+                    "aot": len(self._aot_keys),
+                    "retraces": len(self.lazy_batch_sizes),
+                    "lazy_batch_sizes": list(self.lazy_batch_sizes)}
+
     @property
     def aot_count(self) -> int:
-        return len(self._aot_keys)
+        return self.counts()["aot"]
 
     @property
     def retraces(self) -> int:
         """Lazy (non-AOT) compilations — each one was a trace+compile
         stall on some caller's hot path, a shape the bucket padding (or
         a Predictor's construction warmup) should have absorbed."""
-        return len(self.lazy_batch_sizes)
+        return self.counts()["retraces"]
 
     def offbucket_batch_sizes(self, buckets: Sequence[int]) -> List[int]:
         """Lazily-traced batch sizes not in ``buckets`` (lint
         provenance; AOT-registered signatures — other servers' buckets,
         Predictor warmups — are deliberate and exempt)."""
         bset = set(int(b) for b in buckets)
-        return sorted({b for b in self.lazy_batch_sizes
+        return sorted({b for b in self.counts()["lazy_batch_sizes"]
                        if b not in bset})
 
 
 # ----------------------------------------------------------------------
 _CACHE: Dict[Tuple, CompiledForward] = {}
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = _tsan.lock("serving.compiled._CACHE_LOCK")
 _HITS = 0
 _MISSES = 0
 

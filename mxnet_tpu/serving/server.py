@@ -60,6 +60,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray import NDArray
+from .. import _tsan
 from .. import faults as _faults
 from .compiled import CompiledForward, compiled_forward
 
@@ -211,7 +212,7 @@ class ModelServer:
                                    * self._data_axis)
                                for b in self.buckets})))
         self._models: Dict[str, _Model] = {}
-        self._cond = threading.Condition()
+        self._cond = _tsan.condition("serving.ModelServer._cond")
         self._thread = None
         self._stop = False
         self._started = False
@@ -435,6 +436,9 @@ class ModelServer:
             # served, failed by the drain, or refused here
             if not self._started or self._stop:
                 raise MXNetError("server not started")
+            if _tsan.TSAN:
+                _tsan.note_write("serving.ModelServer.queue")
+                _tsan.note_write("serving.ModelServer.stats")
             self._rid += 1
             req = _Request(self._rid, arrs, n, self.timeout_s)
             m.queue.append(req)
@@ -515,6 +519,8 @@ class ModelServer:
         now = time.perf_counter()
         expired = []
         with self._cond:
+            if _tsan.TSAN:
+                _tsan.note_write("serving.ModelServer.queue")
             while m.queue and m.queue[0].deadline is not None \
                     and m.queue[0].deadline <= now:
                 r = m.queue.popleft()
@@ -601,6 +607,8 @@ class ModelServer:
                     "batched forward failed: %s" % e))
             return
         with self._cond:
+            if _tsan.TSAN:
+                _tsan.note_write("serving.ModelServer.stats")
             self._stats["batches"] += 1
             self._stats["rows_real"] += total
             self._stats["rows_padded"] += padded
@@ -627,8 +635,15 @@ class ModelServer:
     # ------------------------------------------------------------------
     # observability
     def stats(self) -> Dict:
-        """Counters + batch-occupancy histogram + retrace accounting."""
+        """Counters + batch-occupancy histogram + retrace accounting —
+        one atomic snapshot per lock: the server counters under
+        ``_cond`` (the scheduler mutates them mid-cycle), each compiled
+        forward's trace counters under ITS lock (``cf.counts()``; a
+        concurrent lazy trace bumps them from another thread)."""
         with self._cond:
+            if _tsan.TSAN:
+                _tsan.note_read("serving.ModelServer.stats")
+                _tsan.note_read("serving.ModelServer.queue")
             s = dict(self._stats)
             occ = {str(b): {"batches": v[0],
                             "mean_fill": round(v[1] / (v[0] * b), 3)}
@@ -640,9 +655,9 @@ class ModelServer:
             if s["rows_padded"] else 0.0
         s["queue_depth"] = depth
         s["buckets"] = list(self.buckets)
-        cfs = self._cf_groups()
-        s["aot_compiles"] = sum(cf.aot_count for cf, _ in cfs)
-        s["retraces"] = sum(cf.retraces for cf, _ in cfs)
+        counts = [cf.counts() for cf, _ in self._cf_groups()]
+        s["aot_compiles"] = sum(c["aot"] for c in counts)
+        s["retraces"] = sum(c["retraces"] for c in counts)
         s["models"] = sorted(self._models)
         return s
 

@@ -51,3 +51,41 @@ def _seed():
     # depend on how many symbols earlier tests created (process-global state)
     mx.name.NameManager._current.value = mx.name.NameManager()
     yield
+
+
+@pytest.fixture(autouse=True)
+def _mxtpu_thread_leak_check():
+    """No ``mxtpu-*`` thread a test spawns may survive it.
+
+    Every framework thread is named (``mxtpu-serve-sched``,
+    ``mxtpu-upload``, ``mxtpu-hb-<rank>``, ``mxtpu-decode``, ...: the
+    ``unnamed-thread`` lint rule enforces the naming), so a leak is
+    attributable on sight.  A thread parked in a bounded-wait loop
+    (upload staging, decode producer) ends at teardown/GC — the check
+    runs ``gc.collect()`` and grants a short grace before failing, so
+    only a genuinely unowned thread (an un-stopped server, an
+    un-closed iterator, a heartbeat nobody stopped) trips it."""
+    import gc
+    import threading
+    import time
+
+    before = {t for t in threading.enumerate()
+              if t.name.startswith("mxtpu-")}
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t.name.startswith("mxtpu-") and t.is_alive()
+              and t not in before]
+    if leaked:
+        # drop test-local owners (iterators/servers whose __del__ stops
+        # their worker), then give daemon loops one poll interval to
+        # notice the stop flag
+        gc.collect()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline \
+                and any(t.is_alive() for t in leaked):
+            time.sleep(0.05)
+        leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        "mxtpu-* threads leaked by this test: %s — stop()/close() the "
+        "owning server/iterator/heartbeat (docs/how_to/"
+        "static_analysis.md)" % sorted(t.name for t in leaked))

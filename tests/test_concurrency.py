@@ -1,0 +1,463 @@
+"""Concurrency sanitizer: lockset race detector, lock-order cycle
+detector, static thread-safety lint, baseline gate, replay plumbing.
+
+The crafted fixtures are DELIBERATELY racy/inverted — they run inside
+``_tsan.scoped()`` so they neither pollute nor read the process-wide
+recorder (which an ``MXTPU_TSAN=1`` CI sweep owns).
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu import _tsan, analysis                      # noqa: E402
+from mxnet_tpu.analysis import concurrency as cc           # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_threads(*fns):
+    threads = [threading.Thread(target=fn, name="mxtpu-tsan-t%d" % i,
+                                daemon=True)
+               for i, fn in enumerate(fns)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+    return [t.name for t in threads]
+
+
+# ----------------------------------------------------------------------
+# runtime lockset checker
+def test_lockset_flags_unlocked_two_thread_write():
+    """Two named threads mutate registered shared state with no lock:
+    the checker must flag it, naming both threads."""
+    with _tsan.scoped() as rec:
+        counter = {"n": 0}
+
+        def worker():
+            for _ in range(3):
+                _tsan.note_write("test.counter")
+                counter["n"] += 1
+
+        names = _run_threads(worker, worker)
+        report = analysis.lint_runtime(rec.snapshot())
+    races = [f for f in report.findings if f.rule == "lockset-race"]
+    assert len(races) == 1
+    f = races[0]
+    assert f.severity == "error"
+    assert f.node == "test.counter"
+    for name in names:
+        assert name in f.detail["threads"], f.detail
+        assert name in f.detail["writer_threads"]
+    # stack provenance: the access examples carry file:line frames
+    assert any("test_concurrency.py" in v
+               for k, v in f.detail.items() if k.startswith("access_"))
+
+
+def test_lockset_clean_under_common_lock_and_readonly_and_lockfree():
+    """Consistent locking, read-only sharing, and registered lockfree
+    handoffs all stay clean."""
+    with _tsan.scoped() as rec:
+        mu = _tsan.lock("test.mu")
+
+        def locked():
+            for _ in range(3):
+                with mu:
+                    _tsan.note_write("test.locked_state")
+
+        def reader():
+            _tsan.note_read("test.readonly_state")
+
+        def queueish():
+            _tsan.note_write("test.queue_state", lockfree=True,
+                             reason="queue handoff")
+
+        _run_threads(locked, locked, reader, reader, queueish, queueish)
+        report = analysis.lint_runtime(rec.snapshot())
+    assert report.errors() == [], report.summary()
+
+
+def test_lockset_single_thread_unlocked_is_clean():
+    with _tsan.scoped() as rec:
+        for _ in range(3):
+            _tsan.note_write("test.local_state")
+        report = analysis.lint_runtime(rec.snapshot())
+    assert report.errors() == []
+
+
+# ----------------------------------------------------------------------
+# lock-order cycle detector
+def test_lock_order_inversion_detected_with_provenance():
+    """Thread A takes L1 then L2; thread B takes L2 then L1 (run
+    serially so the test itself cannot deadlock): the acquisition graph
+    has a cycle and the finding names both edges' threads."""
+    with _tsan.scoped() as rec:
+        l1, l2 = _tsan.lock("test.L1"), _tsan.lock("test.L2")
+
+        def ab():
+            with l1:
+                with l2:
+                    pass
+
+        def ba():
+            with l2:
+                with l1:
+                    pass
+
+        _run_threads(ab)
+        _run_threads(ba)
+        report = analysis.lint_runtime(rec.snapshot())
+    cycles = [f for f in report.findings
+              if f.rule == "lock-order-inversion"]
+    assert len(cycles) == 1
+    f = cycles[0]
+    assert f.severity == "error"
+    assert "test.L1" in f.node and "test.L2" in f.node
+    edges = {k: v for k, v in f.detail.items() if k.startswith("edge ")}
+    assert len(edges) == 2
+    assert any("mxtpu-tsan-t0" in v for v in edges.values())
+    assert all("test_concurrency.py" in v for v in edges.values())
+
+
+def test_lock_order_consistent_nesting_is_clean():
+    with _tsan.scoped() as rec:
+        outer, inner = _tsan.lock("test.outer"), _tsan.lock("test.inner")
+
+        def nest():
+            with outer:
+                with inner:
+                    pass
+
+        _run_threads(nest, nest)
+        report = analysis.lint_runtime(rec.snapshot())
+    assert report.errors() == []
+
+
+def test_condition_wait_releases_lock_in_held_set():
+    """A Condition built on an instrumented lock: wait() releases the
+    lock through the wrapper, so state touched by ANOTHER thread while
+    the waiter sleeps shows the true (empty) lockset."""
+    with _tsan.scoped() as rec:
+        cond = _tsan.condition("test.cond")
+        woke = []
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                woke.append(True)
+
+        def poker():
+            time.sleep(0.05)
+            _tsan.note_write("test.cond_state")   # no lock held
+            with cond:
+                _tsan.note_write("test.cond_state")
+                cond.notify_all()
+
+        _run_threads(waiter, poker)
+        assert woke
+        snap = rec.snapshot()
+    st = snap["states"]["test.cond_state"]
+    assert st["common"] == []        # intersection over the two accesses
+
+
+# ----------------------------------------------------------------------
+# replay (the cross-process CI path)
+def test_event_log_replay_reproduces_findings(tmp_path):
+    log = str(tmp_path / "tsan.jsonl")
+    with _tsan.scoped() as rec:
+        def worker():
+            _tsan.note_write("test.replayed")
+
+        _run_threads(worker, worker)
+        rec.flush()  # no-op (a scoped recorder never has a log path)
+        snap = rec.snapshot()
+        # write the events the recorder would have logged
+        with open(log, "w") as f:
+            for ex in snap["states"]["test.replayed"]["examples"]:
+                f.write(json.dumps({"k": ex["kind"], "o": "test.replayed",
+                                    "t": ex["thread"], "h": ex["held"],
+                                    "s": ex["stack"]}) + "\n")
+            f.write("torn {not json\n")            # must be skipped
+    report = analysis.replay_log(log)
+    assert [f.node for f in report.errors()] == ["test.replayed"]
+
+    # and the CLI gate fails on it (runtime baseline allows zero)
+    from tools import concurrency_lint
+    rc = concurrency_lint.main(["--no-static", "--replay", log, "--check"])
+    assert rc == 1
+
+
+def test_live_log_written_and_replayable(tmp_path):
+    """End-to-end: a scoped recorder with a configured log path flushes
+    JSONL events that replay to the same verdict."""
+    log = str(tmp_path / "live.jsonl")
+    with _tsan.scoped() as rec:
+        rec.log_path = log
+
+        def worker():
+            _tsan.note_write("test.live")
+
+        _run_threads(worker, worker)
+        rec.flush()
+    events = _tsan.parse_log(log)
+    assert any(e["o"] == "test.live" for e in events)
+    report = analysis.lint_events(events)
+    assert [f.node for f in report.errors()] == ["test.live"]
+
+
+def test_scoped_recorder_does_not_pollute_live_log(tmp_path):
+    """A scoped test recorder must never append its deliberately-racy
+    fixture events to the log a live MXTPU_TSAN=1 sweep is collecting
+    (the sweep's replay gate would fail on them)."""
+    log = str(tmp_path / "sweep.jsonl")
+    live = _tsan.recorder()
+    prev = live.log_path
+    live.log_path = log              # simulate the live sweep's log
+    try:
+        with _tsan.scoped():
+            def worker():
+                _tsan.note_write("test.scoped_polluter")
+
+            _run_threads(worker, worker)
+            _tsan.flush_log()        # flushes the SCOPED recorder
+        _tsan.flush_log()            # and now the live one
+    finally:
+        live.log_path = prev
+    events = _tsan.parse_log(log) if os.path.exists(log) else []
+    assert not any(e["o"] == "test.scoped_polluter" for e in events)
+
+
+# ----------------------------------------------------------------------
+# zero-overhead-off contract
+def test_off_means_plain_threading_primitives():
+    assert not _tsan.enabled() or os.environ.get("MXTPU_TSAN") == "1"
+    was = _tsan.TSAN
+    _tsan.disable()
+    try:
+        assert type(_tsan.lock("x")) is type(threading.Lock())
+        assert isinstance(_tsan.condition("x"), threading.Condition)
+        # and notes are inert (no state recorded)
+        before = len(_tsan.snapshot()["states"])
+        _tsan.note_write("test.never_recorded")
+        assert len(_tsan.snapshot()["states"]) == before
+    finally:
+        if was:
+            _tsan.enable()
+
+
+# ----------------------------------------------------------------------
+# static AST lint
+_RACY_SRC = '''
+import threading
+import time
+
+
+class Racy:
+    def __init__(self):
+        self.count = 0
+        self.total = 0
+        self._lock = threading.Lock()
+        self._t = threading.Thread(target=self._run)
+
+    def _run(self):
+        self.count += 1
+        self._helper()
+        with self._lock:
+            time.sleep(0.1)
+            open("/tmp/x")
+
+    def _helper(self):
+        self.total = 7
+        self.fresh = 1          # not an __init__ attr: not flagged
+        with self._lock:
+            self.count = 0      # locked: not flagged
+
+    def suppressed(self):
+        self.count += 1  # tsan: ok test reason
+'''
+
+
+def test_static_rules_on_crafted_source(tmp_path):
+    src_dir = tmp_path / "pkg"
+    src_dir.mkdir()
+    (src_dir / "racy.py").write_text(_RACY_SRC)
+    report = analysis.lint_source(root=str(src_dir))
+    by_rule = {}
+    for f in report.findings:
+        by_rule.setdefault(f.rule, []).append(f)
+
+    assert len(by_rule["unnamed-thread"]) == 1
+    assert by_rule["unnamed-thread"][0].severity == "error"
+    assert len(by_rule["undeclared-daemon"]) == 1
+
+    muts = by_rule["unlocked-thread-mutation"]
+    assert {f.detail["attr"] for f in muts} == {"count", "total"}
+    # transitive: _helper is reached from the thread target _run
+    assert any(f.op == "Racy._helper" for f in muts)
+    # the '# tsan: ok' marker suppresses its line
+    assert not any(f.op == "Racy.suppressed" for f in muts)
+    assert all(f.severity == "warn" for f in muts)
+
+    blocks = by_rule["blocking-call-under-lock"]
+    assert {f.detail["call"] for f in blocks} == {"sleep", "open"}
+    # provenance is file:line
+    assert all(f.node.startswith("pkg/racy.py:") for f in report.findings)
+
+
+def test_static_scan_clean_at_head():
+    """The framework's own source carries zero error-severity findings
+    (every thread named + daemon-declared; real races fixed, benign
+    ones suppressed with a reason)."""
+    report = analysis.lint_source()
+    assert report.errors() == [], report.summary()
+
+
+# ----------------------------------------------------------------------
+# baseline ratchet + CLI
+def test_race_baseline_holds_at_head():
+    from tools import concurrency_lint
+    assert os.path.exists(concurrency_lint.RACE_BASELINE_PATH)
+    rc = concurrency_lint.main(["--check"])
+    assert rc == 0
+
+
+def test_severity_filter_and_dedupe_key():
+    from mxnet_tpu.analysis import ERROR, Finding, LintReport, WARN
+    r = LintReport(model="t")
+    a = Finding("r1", ERROR, "n", "op", "msg with volatile 17s")
+    b = Finding("r1", ERROR, "n", "op", "msg with volatile 99s")
+    c = Finding("r2", WARN, "n2", "op", "warn")
+    r.extend([a, b, c])
+    assert a.dedupe_key() == b.dedupe_key() != c.dedupe_key()
+    r.dedupe()
+    assert len(r.findings) == 2
+    r.filter_severity("error")
+    assert [f.rule for f in r.findings] == ["r1"]
+
+
+def test_graph_lint_cli_severity_flag():
+    """--severity error hides warn findings from the printed report but
+    the baseline gate still judges (and passes) the full set."""
+    from tools import graph_lint
+    rc = graph_lint.main(["--model", "resnet-50", "--no-trace",
+                          "--severity", "error", "--check"])
+    assert rc == 0
+
+
+# ----------------------------------------------------------------------
+# thread naming + leak check plumbing
+def test_framework_threads_are_named_mxtpu():
+    """The upload stager and heartbeat threads carry mxtpu-* names (the
+    leak fixture and the sanitizer key on them)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import health
+
+    it = mx.io.NDArrayIter(np.zeros((8, 4), "f"), np.zeros((8,), "f"),
+                           batch_size=4)
+    up = mx.io.DeviceUploadIter(it, depth=1)
+    try:
+        up.next()
+        names = {t.name for t in threading.enumerate()}
+        assert "mxtpu-upload" in names
+    finally:
+        up.reset()
+
+    hb_dir = "/tmp/mxtpu_hb_test_%d" % os.getpid()
+    os.makedirs(hb_dir, exist_ok=True)
+    hb = health.Heartbeat(3, directory=hb_dir, interval=0.05)
+    try:
+        assert any(t.name == "mxtpu-hb-3" for t in threading.enumerate())
+    finally:
+        hb.stop()
+    assert not any(t.name == "mxtpu-hb-3" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+@pytest.mark.parametrize("mode", ["close", "epoch_end"])
+def test_record_iter_producer_thread_stops(tmp_path, mode):
+    """The thread-mode decode producer ends both ways: epoch fully
+    consumed, or close() mid-epoch (the leak the conftest check would
+    flag)."""
+    import io as pio
+
+    import numpy as np
+    from PIL import Image
+
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import PyImageRecordIter
+
+    rec = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = Image.fromarray(rng.randint(0, 255, (8, 8, 3),
+                                          dtype=np.uint8))
+        buf = pio.BytesIO()
+        img.save(buf, format="JPEG", quality=95)
+        w.write(recordio.pack(recordio.IRHeader(0, float(i % 2), i, 0),
+                              buf.getvalue()))
+    w.close()
+
+    it = PyImageRecordIter(path_imgrec=rec, data_shape=(3, 8, 8),
+                           batch_size=2, preprocess_threads=1,
+                           prefetch_buffer=2)
+    if mode == "epoch_end":
+        n = 0
+        while it.iter_next():
+            n += 1
+        assert n == 3
+    else:
+        it.next()
+    it.close()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and any(
+            t.name == "mxtpu-decode" and t.is_alive()
+            for t in threading.enumerate()):
+        time.sleep(0.05)
+    assert not any(t.name == "mxtpu-decode" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# ----------------------------------------------------------------------
+# instrumented-at-HEAD cleanliness: the real runtime under TSAN
+def test_instrumented_serving_and_upload_clean():
+    """Drive a real ModelServer + DeviceUploadIter under a scoped
+    recorder: the framework's own locking discipline must produce ZERO
+    findings (the in-process version of the CI sweep)."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import serving
+
+    with _tsan.scoped() as rec:
+        data = mx.sym.Variable("data")
+        net = mx.symbol.FullyConnected(data, num_hidden=8, name="cfc1")
+        sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+        rng = np.random.RandomState(0)
+        args = {"cfc1_weight": mx.nd.array(rng.randn(8, 6).astype("f")),
+                "cfc1_bias": mx.nd.array(np.zeros(8, "f"))}
+        srv = serving.ModelServer(buckets=[1, 2], max_wait_us=500)
+        srv.add_model("m", sym, args, {}, input_shapes={"data": (6,)})
+        with srv:
+            futs = [srv.submit(data=np.zeros((6,), "f")) for _ in range(8)]
+            for f in futs:
+                f.result(timeout=30)
+            srv.stats()
+
+        it = mx.io.NDArrayIter(np.zeros((16, 4), "f"),
+                               np.zeros((16,), "f"), batch_size=4)
+        up = mx.io.DeviceUploadIter(it, depth=2)
+        for _ in range(2):
+            up.next()
+            up.stats()
+        up.reset()
+        report = analysis.lint_runtime(rec.snapshot())
+    assert report.errors() == [], report.summary()

@@ -14,7 +14,15 @@ def test_heartbeat_detection(tmp_path, monkeypatch):
     time.sleep(0.15)
     assert health.dead_nodes(2, timeout=1.0) == []
     h1.stop()                         # rank 1 "dies"
-    time.sleep(0.5)
+    # poll: the sequence-progress scan deliberately treats the FIRST
+    # observation of a newly-advanced stamp as fresh, so a beat that
+    # lands between the scan above and stop() buys rank 1 one more
+    # scan period of apparent liveness — a single fixed sleep is
+    # timing-fragile under load
+    deadline = time.time() + 10.0
+    while time.time() < deadline \
+            and health.dead_nodes(2, timeout=0.3) != [1]:
+        time.sleep(0.1)
     assert health.dead_nodes(2, timeout=0.3) == [1]
     # a never-started rank counts as dead too
     assert health.dead_nodes(3, timeout=0.3) == [1, 2]

@@ -19,7 +19,6 @@ the checked-in ``LINT_BASELINE.json`` and exits non-zero on NEW errors
 intentional change.  Rule catalog: ``docs/how_to/graph_lint.md``.
 """
 import argparse
-import json
 import os
 import sys
 
@@ -150,6 +149,10 @@ def main(argv=None):
     ap.add_argument("--write-baseline", action="store_true",
                     help="record current findings into the baseline "
                          "(ratchet after an intentional change)")
+    ap.add_argument("--severity", choices=("error", "warn", "info"),
+                    default=None,
+                    help="minimum severity to report (display filter; "
+                         "the --check gate always judges errors)")
     ap.add_argument("--json", action="store_true",
                     help="emit the full reports as one JSON object")
     ap.add_argument("--max-findings", type=int, default=25,
@@ -202,12 +205,15 @@ def main(argv=None):
                 trace=not args.no_trace, is_train=not args.eval,
                 dtype_policy=args.policy, model=name)
 
-    if args.json:
-        print(json.dumps({n: r.to_dict() for n, r in reports.items()},
-                         indent=1))
-    else:
-        for name in sorted(reports):
-            print(reports[name].summary(max_findings=args.max_findings))
+    # stable-key dedupe + display-severity filter (render_reports is
+    # shared with tools/concurrency_lint.py so graph and concurrency
+    # findings read as one report format; it filters display copies —
+    # the gate below still judges everything)
+    for r in reports.values():
+        r.dedupe()
+    print(analysis.render_reports(reports, severity=args.severity,
+                                  as_json=args.json,
+                                  max_findings=args.max_findings))
 
     if args.write_baseline:
         path = analysis.write_baseline(reports)
