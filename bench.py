@@ -632,10 +632,25 @@ def main():
     # skips (5 small AOT compiles + ~2 s of load).
     if os.environ.get("MXTPU_BENCH_SERVING", "1") != "0":
         try:
-            from tools.serve_bench import serving_probe
+            from tools.serve_bench import overload_probe, serving_probe
             line["serving"] = serving_probe(quick=True)
+            # goodput under overload (docs/how_to/serving.md "Overload
+            # & degradation"): 1x-8x offered load with admission
+            # control on — the quick sweep, asserted below
+            line["overload"] = overload_probe(quick=True)
         except Exception as e:                      # noqa: BLE001
             line["serving_error"] = str(e)
+        ov = line.get("overload")
+        if ov is not None and not ov.get("degradation_ok", True):
+            # the degradation invariant is a GATE, not a statistic: a
+            # server whose goodput collapses past saturation has no
+            # overload story, whatever its peak numbers say
+            raise RuntimeError(
+                "overload degradation invariant FAILED: goodput at %sx "
+                "offered load (%.1f rps) < 0.9x goodput at %sx (%.1f "
+                "rps) — see INFER_BENCH.json 'overload'"
+                % (ov["max_load_factor"], ov["goodput_max_load_rps"],
+                   ov["base_load_factor"], ov["goodput_base_rps"]))
 
     # --- elastic recovery drill (docs/how_to/multi_host.md "Elastic
     # training"): detect->resumed-first-step wall time from a real

@@ -148,7 +148,8 @@ python tools/concurrency_lint.py --check
 TSAN_LOG="$(mktemp)"
 timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 \
     MXTPU_TSAN_LOG="$TSAN_LOG" \
-    python -m pytest tests/test_serving.py tests/test_stream_pipeline.py \
+    python -m pytest tests/test_serving.py tests/test_serving_overload.py \
+        tests/test_stream_pipeline.py \
         tests/test_elastic.py -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"
@@ -170,6 +171,18 @@ stage "serving layer (continuous batching / AOT shape buckets / fault isolation)
 # docs/how_to/serving.md
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serving.py -q
+
+stage "serving overload suite (admission control / breaker / drain / supervision)"
+# the graceful-degradation half of the serving story: bounded-queue
+# reject vs block backpressure, EWMA deadline shedding before AND
+# after dispatch, request cancellation, the per-model circuit breaker,
+# scheduler-crash fails-all, stop(drain_s), round-robin tenant
+# fairness, and the goodput-under-overload invariant (goodput at max
+# offered load >= 0.9x the 1x goodput).  HARD timeout: a wedged
+# backpressure wait or a stranded future must FAIL this stage, not
+# hang the suite — docs/how_to/serving.md "Overload & degradation"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_serving_overload.py -q
 
 stage "fault-injection suite (sentinel / crash-resume / io recovery)"
 # every recovery path driven on demand via MXTPU_FAULTS — step sentinel
@@ -200,12 +213,13 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
 # test_elastic.py, test_resilience.py, test_serving.py,
-# test_stream_pipeline.py and test_zero_accum.py already ran as their
-# own stages above
+# test_serving_overload.py, test_stream_pipeline.py and
+# test_zero_accum.py already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_elastic.py \
     --ignore=tests/test_resilience.py \
     --ignore=tests/test_serving.py \
+    --ignore=tests/test_serving_overload.py \
     --ignore=tests/test_stream_pipeline.py \
     --ignore=tests/test_zero_accum.py \
     ${PYTEST_MARK[@]+"${PYTEST_MARK[@]}"}

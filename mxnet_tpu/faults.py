@@ -11,7 +11,7 @@ Spec grammar (``MXTPU_FAULTS`` or :func:`configure`)::
 
     spec      := directive (";" directive)*
     directive := kind "@" item (":" item)*
-    item      := key "=" int | bare-word
+    item      := key "=" int | key "=" word | bare-word
 
 * ``kind`` names the fault (``nan_grad``, ``io_error``, ``crash``,
   ``host_dead``, ``hb_stall``, ...).
@@ -20,6 +20,11 @@ Spec grammar (``MXTPU_FAULTS`` or :func:`configure`)::
   except ``rank``, which matches EXACTLY (a rank is an identity, not a
   counter: ``host_dead@step=3:rank=1`` must kill rank 1, not every
   rank >= 1).
+* A ``key=word`` item names a STRING identity and matches EXACTLY
+  against the site's context value (``batch_error@model=ranker`` fails
+  only that tenant's batches).  Only declared identity keys
+  (``model``) take strings — a typo'd integer value is still a parse
+  error, not a directive that silently never fires.
 * A bare word must equal the site's ``site=`` context value
   (``crash@ckpt_write`` fires at the checkpoint-write site).
 * ``count=N`` fires the directive on its first N armed hits
@@ -60,11 +65,21 @@ spec is installed):
   payload NaN-filled, exercising per-request error isolation: the
   output-finiteness check fails THAT future, the rest of the batch
   completes (``docs/how_to/serving.md``).
+* ``batch_error`` — the serving scheduler.  With ``model=NAME``
+  (exact string match) the named tenant's next ``count=K`` dispatched
+  batches raise inside ``_run_batch`` — the whole-batch failure that
+  feeds the per-model circuit breaker (K consecutive failures open
+  it).  With the bare site word ``sched``
+  (``batch_error@sched``) the exception is raised in the scheduler
+  LOOP itself, outside the per-batch recovery — driving the
+  supervision path: every pending future fails, the server flips to
+  rejecting (``docs/how_to/serving.md`` "Overload & degradation").
 
 Example::
 
     MXTPU_FAULTS="nan_grad@step=3;io_error@batch=5:count=2;crash@ckpt_write"
     MXTPU_FAULTS="poison_request@request=7;slow_request@request=12:count=3"
+    MXTPU_FAULTS="batch_error@model=ranker:count=5"
 """
 from __future__ import annotations
 
@@ -83,6 +98,11 @@ _ENV = "MXTPU_FAULTS"
 # exactly, not as a >= threshold (killing "rank 1" must not also kill
 # rank 2)
 _EXACT_KEYS = frozenset(("rank",))
+
+# identity keys whose values are STRINGS (matched exactly); every other
+# key still requires an integer — "io_error@batch=soon" stays a parse
+# error, not a directive that silently never fires
+_STRING_KEYS = frozenset(("model",))
 
 
 class InjectedCrash(BaseException):
@@ -114,7 +134,11 @@ class _Directive:
             val = ctx.get(key)
             if val is None:
                 return False
-            if key in _EXACT_KEYS:
+            if isinstance(threshold, str):
+                # identity string (model=NAME): exact match
+                if str(val) != threshold:
+                    return False
+            elif key in _EXACT_KEYS:
                 if int(val) != threshold:
                     return False
             elif int(val) < threshold:
@@ -141,12 +165,20 @@ def _parse(spec: str) -> List[_Directive]:
                 continue
             key, eq, val = item.partition("=")
             if eq:
+                if key in _STRING_KEYS:
+                    # an identity string, matched exactly — checked
+                    # BEFORE int() so a tenant literally named "2"
+                    # stays a string, not a threshold
+                    conds[key] = val.strip()
+                    continue
                 try:
                     ival = int(val)
                 except ValueError:
                     raise MXNetError(
                         "bad fault condition %r in %r (values are "
-                        "integers)" % (item, raw)) from None
+                        "integers; string identities: %s)"
+                        % (item, raw,
+                           "/".join(sorted(_STRING_KEYS)))) from None
                 if key == "count":
                     count = ival
                 else:

@@ -17,8 +17,10 @@ adds the rest of the contract:
   verifies every listed artifact against its recorded CRC/size, and
   falls back past truncated/corrupt/incomplete candidates to the newest
   checkpoint that checks out.
-* :func:`retry_io` — bounded retry-with-backoff for transient iterator
-  and checkpoint IO failures (the flaky-NFS / preempted-reader class),
+* :func:`retry_io` — bounded retry-with-backoff (decorrelated jitter,
+  so concurrent ranks retrying the same shared-dir fault desynchronize
+  instead of hammering it in lockstep) for transient iterator and
+  checkpoint IO failures (the flaky-NFS / preempted-reader class),
   used by ``BaseModule.fit``'s inner loop and by every manager write.
 
 ``BaseModule.fit(..., checkpoint=prefix, resume=True)`` wires all of it
@@ -33,6 +35,7 @@ import glob
 import json
 import logging
 import os
+import random
 import time
 import zlib
 from typing import Callable, Optional, Sequence, Tuple
@@ -45,22 +48,34 @@ _MANIFEST_VERSION = 1
 
 
 def retry_io(fn: Callable, attempts: int = 3, delay: float = 0.05,
-             backoff: float = 2.0,
+             backoff: float = 2.0, jitter: float = 0.1,
              exceptions: Tuple = (OSError,), what: str = "io",
-             logger=logging):
-    """Call ``fn()`` with up to ``attempts`` tries, sleeping
+             logger=logging, rng=None):
+    """Call ``fn()`` with up to ``attempts`` tries, sleeping roughly
     ``delay * backoff**k`` between consecutive failures of the
     ``exceptions`` classes; the final failure re-raises.  StopIteration
     and non-listed exceptions propagate immediately (an exhausted
-    iterator or a logic error is not a transient fault)."""
+    iterator or a logic error is not a transient fault).
+
+    ``jitter`` applies DECORRELATED jitter: each sleep is the
+    *previous actual sleep* times ``backoff``, perturbed by a uniform
+    ±``jitter`` fraction — so the perturbations compound and N ranks
+    that hit the same shared-dir fault at the same instant drift apart
+    instead of retrying (and colliding) in lockstep forever.  ``rng``
+    (a ``random.Random``) pins the sequence for tests; 0 disables."""
     attempts = max(1, int(attempts))
+    wait = None
     for attempt in range(attempts):
         try:
             return fn()
         except exceptions as e:
             if attempt + 1 >= attempts:
                 raise
-            wait = delay * (backoff ** attempt)
+            wait = delay if wait is None else wait * backoff
+            if jitter:
+                if rng is None:
+                    rng = random.Random()
+                wait *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
             logger.warning("%s failed (attempt %d/%d): %s — retrying "
                            "in %.2fs", what, attempt + 1, attempts, e,
                            wait)

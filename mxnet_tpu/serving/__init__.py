@@ -5,7 +5,10 @@ buckets over the compiled forward (ROADMAP item 1).
   scheduler that coalesces concurrent requests onto accelerator-sized
   batches, padding to ahead-of-time-compiled bucket sizes so the hot
   path never retraces, per-request futures/timeouts/error isolation,
-  multi-tenant hosting (N symbols, one server).
+  multi-tenant hosting (N symbols, one server), and graceful
+  degradation under overload: bounded-queue admission control
+  (``reject``/``block``), EWMA deadline shedding, per-model circuit
+  breakers, scheduler supervision, and ``stop(drain_s)``.
 * :class:`~.compiled.CompiledForward` / :func:`~.compiled.compiled_forward`
   — the keyed compiled-forward cache (weights as arguments) shared by
   the server buckets and :class:`~..predictor.Predictor`.
@@ -15,8 +18,11 @@ bench: ``tools/serve_bench.py`` (INFER_BENCH.json ``serving`` section).
 """
 from .compiled import (CompiledForward, cache_stats, clear_cache,
                        compiled_forward)
-from .server import ModelServer, ServeError, ServeFuture, ServeTimeout
+from .server import (ModelServer, ServeCancelled, ServeError,
+                     ServeFuture, ServeOverload, ServeTimeout,
+                     ServeUnavailable)
 
 __all__ = ["ModelServer", "ServeFuture", "ServeError", "ServeTimeout",
+           "ServeOverload", "ServeUnavailable", "ServeCancelled",
            "CompiledForward", "compiled_forward", "cache_stats",
            "clear_cache"]

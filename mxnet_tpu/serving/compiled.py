@@ -104,6 +104,12 @@ class CompiledForward:
         self._aot_keys: set = set()     # signatures compiled at startup
         self._aot_tls = threading.local()
         self._lock = _tsan.lock("serving.CompiledForward._lock")
+        # execute-latency EWMA (overall + per padded batch size), fed by
+        # the server after each dispatched batch and consumed by its
+        # deadline-aware shedding — a program property (one executable,
+        # one latency curve), so shared-symbol tenants share it too
+        self._ewma_run_s: Optional[float] = None
+        self._bucket_run_s: Dict[int, float] = {}
         # eval-mode RNG: one constant key.  Serving is deterministic by
         # contract — a model whose eval forward draws (sampling heads)
         # gets the same stream every call; per-call keys would make the
@@ -199,6 +205,40 @@ class CompiledForward:
         host or device array; returns the output tuple (device
         arrays)."""
         return self._jit(params, aux, batch, self._rng)
+
+    # ------------------------------------------------------------------
+    # latency bookkeeping (the server's deadline-aware shed reads this)
+    _EWMA_ALPHA = 0.3
+
+    def record_latency(self, rows: int, dt_s: float) -> None:
+        """Fold one observed execute latency (``rows`` = the padded
+        batch size that ran) into the EWMA."""
+        a = self._EWMA_ALPHA
+        with self._lock:
+            if _tsan.TSAN:
+                _tsan.note_write("serving.CompiledForward.latency")
+            self._ewma_run_s = dt_s if self._ewma_run_s is None \
+                else (1.0 - a) * self._ewma_run_s + a * dt_s
+            prev = self._bucket_run_s.get(rows)
+            self._bucket_run_s[rows] = dt_s if prev is None \
+                else (1.0 - a) * prev + a * dt_s
+
+    def expected_latency_s(self) -> Optional[float]:
+        """The overall execute-latency EWMA (None until a batch has
+        run) — what a queued request should budget for the compute
+        ahead of it."""
+        with self._lock:
+            if _tsan.TSAN:
+                _tsan.note_read("serving.CompiledForward.latency")
+            return self._ewma_run_s
+
+    def latency_ms_by_bucket(self) -> Dict[str, float]:
+        """Per-padded-batch-size latency EWMA snapshot (observability)."""
+        with self._lock:
+            if _tsan.TSAN:
+                _tsan.note_read("serving.CompiledForward.latency")
+            return {str(b): round(v * 1e3, 3)
+                    for b, v in sorted(self._bucket_run_s.items())}
 
     # ------------------------------------------------------------------
     def counts(self) -> Dict:

@@ -29,17 +29,51 @@ OWN future via the per-request output-finiteness check while the rest
 of the batch completes, and expired requests fail with a timeout before
 ever entering a batch.
 
+**Overload protection / graceful degradation** (the robustness mirror
+of the throughput story — a serving layer is judged by its degradation
+curve, not its peak):
+
+* **admission control** — per-model queues are bounded at
+  ``queue_cap`` rows; past it ``submit()`` sheds per ``shed_policy``:
+  ``reject`` raises :class:`ServeOverload` immediately (fail fast, the
+  client retries elsewhere), ``block`` applies backpressure — the
+  caller waits on the queue up to the request deadline, then
+  :class:`ServeOverload`.
+* **deadline-aware scheduling** — a queued request whose remaining
+  deadline cannot cover the model's EWMA batch latency is shed at
+  ``_take_batch`` time (``shed_deadline``) instead of burning a
+  dispatch it will miss anyway; expiry is re-checked after compute so
+  a late result fails its future (``expired_after_dispatch``) rather
+  than pretending to be on time; :meth:`ServeFuture.cancel` removes a
+  still-queued request and frees its rows.
+* **per-model circuit breaker** — ``breaker_k`` consecutive batch
+  failures open the breaker: that model's submits fail immediately
+  with :class:`ServeUnavailable` (other tenants unaffected) until a
+  cool-down, after which one half-open probe batch decides: success
+  closes, failure re-opens.
+* **scheduler supervision** — an uncaught scheduler exception fails
+  EVERY pending future and flips the server to rejecting (a crash is
+  loud, never a silent hang); ``stop(drain_s=...)`` serves already-
+  queued work up to a deadline before failing the remainder; multi-
+  tenant dispatch rotates round-robin across models so one hot tenant
+  cannot starve the rest.
+
 Knobs (constructor arg wins over ``MXTPU_SERVE_*`` env):
 
-====================  =========================  =======================
-constructor            env                        default
-====================  =========================  =======================
-``buckets``           ``MXTPU_SERVE_BUCKETS``    ``1,4,8,16,32``
-``max_wait_us``       ``MXTPU_SERVE_MAX_WAIT_US``  ``2000``
-``cap``               ``MXTPU_SERVE_CAP``        largest bucket
-``timeout_ms``        ``MXTPU_SERVE_TIMEOUT_MS`` ``10000`` (0 = off)
-``validate``          ``MXTPU_SERVE_VALIDATE``   ``1`` (finiteness check)
-====================  =========================  =======================
+======================  ==============================  =================
+constructor              env                             default
+======================  ==============================  =================
+``buckets``             ``MXTPU_SERVE_BUCKETS``         ``1,4,8,16,32``
+``max_wait_us``         ``MXTPU_SERVE_MAX_WAIT_US``     ``2000``
+``cap``                 ``MXTPU_SERVE_CAP``             largest bucket
+``timeout_ms``          ``MXTPU_SERVE_TIMEOUT_MS``      ``10000`` (0 = off)
+``validate``            ``MXTPU_SERVE_VALIDATE``        ``1`` (finiteness)
+``queue_cap``           ``MXTPU_SERVE_QUEUE_CAP``       ``4096`` rows (0 = off)
+``shed_policy``         ``MXTPU_SERVE_SHED_POLICY``     ``reject`` | ``block``
+``breaker_k``           ``MXTPU_SERVE_BREAKER_K``       ``5`` (0 = off)
+``breaker_cooldown_ms`` ``MXTPU_SERVE_BREAKER_COOLDOWN_MS``  ``1000``
+``stop(drain_s=)``      ``MXTPU_SERVE_DRAIN_S``         ``0`` (fail tail)
+======================  ==============================  =================
 
 See ``docs/how_to/serving.md`` for the architecture walkthrough and
 ``tools/serve_bench.py`` for the Poisson load generator that produces
@@ -64,7 +98,8 @@ from .. import _tsan
 from .. import faults as _faults
 from .compiled import CompiledForward, compiled_forward
 
-__all__ = ["ModelServer", "ServeFuture", "ServeTimeout", "ServeError"]
+__all__ = ["ModelServer", "ServeFuture", "ServeTimeout", "ServeError",
+           "ServeOverload", "ServeUnavailable", "ServeCancelled"]
 
 
 class ServeError(MXNetError):
@@ -75,10 +110,29 @@ class ServeTimeout(ServeError):
     """A request's deadline expired before it was served."""
 
 
+class ServeOverload(ServeError):
+    """Shed by admission control: the model's queue is at ``queue_cap``
+    rows (``reject`` policy, or the ``block`` backpressure wait outlived
+    the request deadline).  Fails FAST — an overloaded server must say
+    no in microseconds, not let p99 grow without bound."""
+
+
+class ServeUnavailable(ServeError):
+    """The model (circuit breaker open) or the whole server (scheduler
+    crashed, draining) is refusing new work."""
+
+
+class ServeCancelled(ServeError):
+    """The request was cancelled while still queued (explicit
+    :meth:`ServeFuture.cancel`, or a ``result``/``exception`` wait that
+    timed out and reclaimed the queued rows)."""
+
+
 class ServeFuture:
     """Completion handle for one submitted request."""
 
-    __slots__ = ("_done", "_result", "_exc", "t_submit", "t_done")
+    __slots__ = ("_done", "_result", "_exc", "t_submit", "t_done",
+                 "_cancel_cb")
 
     def __init__(self):
         self._done = threading.Event()
@@ -86,6 +140,7 @@ class ServeFuture:
         self._exc = None
         self.t_submit = time.perf_counter()
         self.t_done = None
+        self._cancel_cb = None
 
     def _set_result(self, outs):
         self._result = outs
@@ -100,11 +155,26 @@ class ServeFuture:
     def done(self) -> bool:
         return self._done.is_set()
 
+    def cancel(self) -> bool:
+        """Remove the request from its queue if it has not been
+        dispatched yet.  Returns True when the request was still queued
+        — its rows are freed from the model's ``pending`` budget and
+        this future fails with :class:`ServeCancelled`.  Returns False
+        when the request already completed or already entered a batch
+        (an in-flight batch is never torn apart; the result simply
+        arrives)."""
+        if self._done.is_set() or self._cancel_cb is None:
+            return False
+        return self._cancel_cb()
+
     def result(self, timeout: Optional[float] = None) -> List[np.ndarray]:
         """Block for the outputs (one array per graph output, leading
         dim = this request's row count).  Raises what the request
-        raised."""
+        raised.  A wait that times out CANCELS the request if it is
+        still queued — an abandoned wait must not keep consuming
+        scheduler work and queue rows."""
         if not self._done.wait(timeout):
+            self.cancel()
             raise ServeTimeout("request not completed within %ss" % timeout)
         if self._exc is not None:
             raise self._exc
@@ -112,6 +182,7 @@ class ServeFuture:
 
     def exception(self, timeout: Optional[float] = None):
         if not self._done.wait(timeout):
+            self.cancel()
             raise ServeTimeout("request not completed within %ss" % timeout)
         return self._exc
 
@@ -141,7 +212,8 @@ class _Model:
 
     __slots__ = ("name", "symbol", "cf", "params", "aux", "example_shapes",
                  "label_trailing", "input_dtypes", "queue", "pending",
-                 "n_outputs")
+                 "n_outputs", "breaker", "consec_failures", "opened_at",
+                 "batches", "sheds_since_batch")
 
     def __init__(self, name, symbol, cf, params, aux, example_shapes,
                  label_trailing, input_dtypes, n_outputs):
@@ -158,6 +230,19 @@ class _Model:
         # scheduler wakeup would make draining a backlog quadratic
         self.pending = 0
         self.n_outputs = n_outputs
+        # circuit breaker (all mutated under the server's _cond):
+        # closed -> open after breaker_k consecutive batch failures,
+        # open -> half_open after the cool-down admits one probe,
+        # half_open -> closed on probe success / open on probe failure
+        self.breaker = "closed"
+        self.consec_failures = 0
+        self.opened_at = None
+        self.batches = 0                        # dispatched for this model
+        # EWMA-shed escape hatch: consecutive sheds since the last
+        # dispatched batch.  An anomalous slow batch can inflate the
+        # EWMA past every deadline; without a probe, no batch would
+        # ever run again to decay it (permanent 100% shed).
+        self.sheds_since_batch = 0
 
 
 def _env_int(name, default):
@@ -171,12 +256,21 @@ def _env_int(name, default):
 class ModelServer:
     """Thread-safe continuous-batching server over one or more models."""
 
+    # after this many consecutive EWMA deadline-sheds with no batch
+    # dispatched, one request goes through as a latency probe (see
+    # _take_batch) — the anti-latch bound on predictive shedding
+    _SHED_PROBE_EVERY = 8
+
     def __init__(self, buckets: Optional[Sequence[int]] = None,
                  max_wait_us: Optional[int] = None,
                  cap: Optional[int] = None,
                  timeout_ms: Optional[int] = None,
                  validate: Optional[bool] = None,
-                 mesh=None):
+                 mesh=None,
+                 queue_cap: Optional[int] = None,
+                 shed_policy: Optional[str] = None,
+                 breaker_k: Optional[int] = None,
+                 breaker_cooldown_ms: Optional[int] = None):
         if buckets is None:
             buckets = [int(b) for b in os.environ.get(
                 "MXTPU_SERVE_BUCKETS", "1,4,8,16,32").split(",") if b]
@@ -195,6 +289,25 @@ class ModelServer:
         if validate is None:
             validate = os.environ.get("MXTPU_SERVE_VALIDATE", "1") != "0"
         self.validate = bool(validate)
+        # admission control: queued rows per model are bounded at
+        # queue_cap (0 = unbounded, the pre-overload-story behavior);
+        # past it submit() sheds per shed_policy
+        self.queue_cap = int(queue_cap) if queue_cap is not None \
+            else _env_int("MXTPU_SERVE_QUEUE_CAP", 4096)
+        if shed_policy is None:
+            shed_policy = os.environ.get("MXTPU_SERVE_SHED_POLICY",
+                                         "reject")
+        if shed_policy not in ("reject", "block"):
+            raise MXNetError("shed_policy %r is not 'reject' or 'block'"
+                             % (shed_policy,))
+        self.shed_policy = shed_policy
+        # circuit breaker: K consecutive whole-batch failures open it
+        # (0 disables); one probe batch is admitted after the cool-down
+        self.breaker_k = int(breaker_k) if breaker_k is not None \
+            else _env_int("MXTPU_SERVE_BREAKER_K", 5)
+        self.breaker_cooldown_s = (
+            breaker_cooldown_ms if breaker_cooldown_ms is not None
+            else _env_int("MXTPU_SERVE_BREAKER_COOLDOWN_MS", 1000)) / 1e3
         self.mesh = mesh
         self._data_axis = 1
         if mesh is not None:
@@ -216,11 +329,21 @@ class ModelServer:
         self._thread = None
         self._stop = False
         self._started = False
+        self._draining = False      # stop(drain_s): serve queue, no admits
+        self._crashed = None        # scheduler supervision: the exception
+        self._rr = 0                # round-robin rotation across models
         self._rid = 0
         # counters (all mutated under _cond)
         self._stats = {"requests": 0, "completed": 0, "failed": 0,
                        "timeouts": 0, "batches": 0, "rows_real": 0,
-                       "rows_padded": 0}
+                       "rows_padded": 0,
+                       # overload / degradation accounting
+                       "rejected_overload": 0,      # queue_cap sheds
+                       "rejected_breaker": 0,       # breaker-open refusals
+                       "shed_deadline": 0,          # EWMA-predicted misses
+                       "expired_after_dispatch": 0,  # late results
+                       "cancelled": 0,              # ServeFuture.cancel
+                       "batch_failures": 0}         # whole-batch errors
         self._occupancy: Dict[int, List[int]] = {}   # bucket -> [batches, rows]
 
     # ------------------------------------------------------------------
@@ -354,6 +477,9 @@ class ModelServer:
                 outs = m.cf.run(m.params, m.aux, feed)
                 np.asarray(outs[0][:1])     # completion barrier
         self._stop = False
+        self._crashed = None    # a stop()/start() restart gets a fresh
+        self._draining = False  # scheduler; stale crash/drain state
+        self._rr = 0            # must not keep rejecting forever
         self._thread = threading.Thread(target=self._loop,
                                         name="mxtpu-serve-sched",
                                         daemon=True)
@@ -366,9 +492,35 @@ class ModelServer:
         shapes.update({n: (b,) + s for n, s in m.label_trailing.items()})
         return shapes
 
-    def stop(self) -> None:
+    def stop(self, drain_s: Optional[float] = None) -> None:
+        """Stop the server.  With ``drain_s`` > 0 (default from
+        ``MXTPU_SERVE_DRAIN_S``), the door closes to NEW submits first
+        (``ServeUnavailable``) while the scheduler keeps serving the
+        already-queued work — dispatching immediately, not waiting out
+        coalescing windows — up to the drain deadline; whatever is
+        still queued past it fails with ``ServeError``."""
+        if drain_s is None:
+            try:
+                drain_s = float(
+                    os.environ.get("MXTPU_SERVE_DRAIN_S", "") or 0.0)
+            except ValueError:
+                raise MXNetError("MXTPU_SERVE_DRAIN_S=%r is not a number"
+                                 % os.environ["MXTPU_SERVE_DRAIN_S"]) \
+                    from None
+        if drain_s > 0 and self._thread is not None:
+            deadline = time.perf_counter() + drain_s
+            with self._cond:
+                self._draining = True
+                self._cond.notify_all()
+                while self._crashed is None \
+                        and any(m.queue for m in self._models.values()):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=min(left, 0.05))
         with self._cond:
             self._stop = True
+            self._draining = True
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=30)
@@ -384,6 +536,7 @@ class ModelServer:
                     leftovers.append(m.queue.popleft())
                 m.pending = 0
             self._started = False
+            self._draining = False
         for r in leftovers:
             r.future._set_exception(ServeError("server stopped"))
 
@@ -430,22 +583,110 @@ class ModelServer:
         if extra:
             raise MXNetError("unknown inputs %s for model %r"
                              % (sorted(extra), m.name))
+        # the request's deadline budget starts at ADMISSION, not at
+        # enqueue: a block-policy wait spends from the same budget, so
+        # end-to-end latency can never reach 2x timeout_s
+        t_admit = time.perf_counter()
         with self._cond:
             # started-check under the lock: see stop() — the enqueue and
             # the shutdown drain are serialized, so a future either gets
             # served, failed by the drain, or refused here
-            if not self._started or self._stop:
-                raise MXNetError("server not started")
+            self._check_admissible(m)
+            if self.queue_cap and n > self.queue_cap:
+                # can NEVER fit, whatever drains — reject up front under
+                # either policy (block would otherwise wait for space
+                # that cannot exist)
+                self._stats["rejected_overload"] += 1
+                raise ServeOverload(
+                    "request (%d rows) exceeds the per-model queue cap "
+                    "(%d rows) — it can never be admitted; raise "
+                    "MXTPU_SERVE_QUEUE_CAP or split the request"
+                    % (n, self.queue_cap))
+            if self.queue_cap and m.pending + n > self.queue_cap:
+                if self.shed_policy == "reject":
+                    self._stats["rejected_overload"] += 1
+                    raise ServeOverload(
+                        "model %r queue is at %d/%d rows — request (%d "
+                        "rows) shed (policy=reject; see MXTPU_SERVE_"
+                        "QUEUE_CAP / MXTPU_SERVE_SHED_POLICY)"
+                        % (m.name, m.pending, self.queue_cap, n))
+                # block policy: backpressure — wait for queue space up
+                # to the request deadline (condition wait releases the
+                # lock, so the scheduler can drain meanwhile)
+                wait_deadline = None if self.timeout_s is None \
+                    else t_admit + self.timeout_s
+                while m.pending + n > self.queue_cap:
+                    left = None if wait_deadline is None \
+                        else wait_deadline - time.perf_counter()
+                    if left is not None and left <= 0:
+                        self._stats["rejected_overload"] += 1
+                        raise ServeOverload(
+                            "model %r queue still at %d/%d rows after "
+                            "blocking %.0f ms (policy=block)"
+                            % (m.name, m.pending, self.queue_cap,
+                               self.timeout_s * 1e3))
+                    self._cond.wait(timeout=0.05 if left is None
+                                    else min(left, 0.05))
+                    self._check_admissible(m)
             if _tsan.TSAN:
                 _tsan.note_write("serving.ModelServer.queue")
                 _tsan.note_write("serving.ModelServer.stats")
             self._rid += 1
-            req = _Request(self._rid, arrs, n, self.timeout_s)
+            remaining = None if self.timeout_s is None else max(
+                0.0, t_admit + self.timeout_s - time.perf_counter())
+            req = _Request(self._rid, arrs, n, remaining)
+            req.future._cancel_cb = \
+                lambda _m=m, _r=req: self._cancel(_m, _r)
             m.queue.append(req)
             m.pending += n
             self._stats["requests"] += 1
             self._cond.notify_all()
         return req.future
+
+    def _check_admissible(self, m: _Model) -> None:
+        """Shutdown / crash / breaker gate, called under ``_cond``."""
+        if not self._started or self._stop:
+            raise MXNetError("server not started")
+        if self._crashed is not None:
+            raise ServeUnavailable(
+                "server is rejecting requests: scheduler crashed (%s)"
+                % self._crashed)
+        if self._draining:
+            raise ServeUnavailable("server is draining (stop(drain_s))")
+        if self.breaker_k and m.breaker == "open":
+            now = time.perf_counter()
+            if m.opened_at is not None \
+                    and now - m.opened_at >= self.breaker_cooldown_s:
+                # cool-down elapsed: admit this request as the half-open
+                # probe — its batch decides closed vs re-opened
+                m.breaker = "half_open"
+            else:
+                self._stats["rejected_breaker"] += 1
+                raise ServeUnavailable(
+                    "model %r unavailable: circuit breaker open (%d "
+                    "consecutive batch failures; probe in %.0f ms)"
+                    % (m.name, m.consec_failures,
+                       max(0.0, self.breaker_cooldown_s
+                           - (now - (m.opened_at or now))) * 1e3))
+
+    def _cancel(self, m: _Model, req: _Request) -> bool:
+        """Back half of :meth:`ServeFuture.cancel`: remove ``req`` from
+        its queue if still there, free its rows, fail its future."""
+        with self._cond:
+            try:
+                m.queue.remove(req)
+            except ValueError:
+                return False        # already dispatched (or drained)
+            if _tsan.TSAN:
+                _tsan.note_write("serving.ModelServer.queue")
+                _tsan.note_write("serving.ModelServer.stats")
+            m.pending -= req.n
+            self._stats["cancelled"] += 1
+            self._stats["failed"] += 1
+            self._cond.notify_all()
+        req.future._set_exception(ServeCancelled(
+            "request %d cancelled while queued" % req.rid))
+        return True
 
     def predict(self, inputs: Optional[Dict] = None,
                 model: Optional[str] = None, **kw) -> List[np.ndarray]:
@@ -466,6 +707,17 @@ class ModelServer:
     # ------------------------------------------------------------------
     # scheduler
     def _loop(self):
+        # supervision wrapper: an exception that escapes the cycle body
+        # (a scheduler BUG, not a bad batch — those are handled below)
+        # must fail every pending future and flip the server to
+        # rejecting.  A crashed scheduler that silently strands futures
+        # is the one failure mode this layer may never have.
+        try:
+            self._loop_body()
+        except Exception as e:                      # noqa: BLE001
+            self._on_crash(e)
+
+    def _loop_body(self):
         while True:
             with self._cond:
                 if self._stop:
@@ -475,7 +727,18 @@ class ModelServer:
                     self._cond.wait(timeout=wait)
                 if self._stop:
                     return
-            for m in list(self._models.values()):
+                # round-robin: rotate which model is served FIRST each
+                # cycle, so one hot tenant's batch time cannot
+                # systematically age (and deadline-shed) the others
+                models = list(self._models.values())
+                if len(models) > 1:
+                    k = self._rr % len(models)
+                    models = models[k:] + models[:k]
+                    self._rr += 1
+            if _faults.hit("batch_error", site="sched"):
+                raise ServeError("injected scheduler crash "
+                                 "(batch_error@sched)")
+            for m in models:
                 batch = self._take_batch(m)
                 if not batch:
                     continue
@@ -492,6 +755,28 @@ class ModelServer:
                             r.future._set_exception(ServeError(
                                 "serve cycle failed: %s" % e))
 
+    def _on_crash(self, exc) -> None:
+        """Scheduler supervision: fail EVERY pending future, then flip
+        the server to rejecting (submit raises ServeUnavailable).  A
+        late submit that raced the crash is failed by the sweep or
+        refused by the flag — nothing hangs."""
+        leftovers = []
+        with self._cond:
+            self._crashed = exc
+            if _tsan.TSAN:
+                _tsan.note_write("serving.ModelServer.queue")
+                _tsan.note_write("serving.ModelServer.stats")
+            for m in self._models.values():
+                while m.queue:
+                    leftovers.append(m.queue.popleft())
+                m.pending = 0
+            self._stats["failed"] += len(leftovers)
+            self._cond.notify_all()
+        for r in leftovers:
+            r.future._set_exception(ServeUnavailable(
+                "scheduler crashed before serving this request: %s"
+                % exc))
+
     def _next_due_s(self) -> Optional[float]:
         """Seconds until the earliest queue needs attention (None =
         nothing pending, sleep until notified)."""
@@ -504,7 +789,7 @@ class ModelServer:
             t = head.t_in + self.max_wait_s
             if head.deadline is not None:
                 t = min(t, head.deadline)
-            if m.pending >= self.cap:
+            if m.pending >= self.cap or self._draining:
                 t = now
             due = t if due is None else min(due, t)
         if due is None:
@@ -515,25 +800,47 @@ class ModelServer:
         """Pop the next admissible batch (largest prefix of the queue
         within ``cap`` rows) — or nothing if the coalescing window is
         still open.  Expired requests fail here, before ever entering a
-        batch."""
+        batch — and so do requests whose REMAINING deadline cannot
+        cover the model's EWMA batch latency: dispatching them would
+        burn a compute slot on a result that arrives dead on delivery
+        (``shed_deadline``).  Every ``_SHED_PROBE_EVERY`` consecutive
+        sheds, one request is let through as a latency PROBE — an
+        anomalous slow batch that inflated the EWMA past every deadline
+        must not latch the model into shedding forever (the probe's
+        real latency re-feeds the EWMA and decays it)."""
+        # read the latency estimate before taking _cond (the estimate
+        # lives under the CompiledForward lock; never nest the two)
+        ewma = m.cf.expected_latency_s()
         now = time.perf_counter()
-        expired = []
+        expired, shed = [], []
         with self._cond:
             if _tsan.TSAN:
                 _tsan.note_write("serving.ModelServer.queue")
-            while m.queue and m.queue[0].deadline is not None \
-                    and m.queue[0].deadline <= now:
-                r = m.queue.popleft()
-                m.pending -= r.n
-                expired.append(r)
+            while m.queue and m.queue[0].deadline is not None:
+                r = m.queue[0]
+                if r.deadline <= now:
+                    expired.append(m.queue.popleft())
+                    m.pending -= r.n
+                elif ewma is not None and r.deadline - now < ewma:
+                    if m.sheds_since_batch >= self._SHED_PROBE_EVERY:
+                        break          # dispatch it as the probe
+                    shed.append(m.queue.popleft())
+                    m.pending -= r.n
+                    m.sheds_since_batch += 1
+                else:
+                    break
             if expired:
                 self._stats["timeouts"] += len(expired)
                 self._stats["failed"] += len(expired)
+            if shed:
+                self._stats["shed_deadline"] += len(shed)
+                self._stats["failed"] += len(shed)
             if not m.queue:
                 batch = []
             else:
                 waited = now - m.queue[0].t_in
-                if m.pending < self.cap and waited < self.max_wait_s:
+                if m.pending < self.cap and waited < self.max_wait_s \
+                        and not self._draining:
                     batch = []
                 else:
                     batch, total = [], 0
@@ -546,10 +853,19 @@ class ModelServer:
                         total += r.n
                         if total >= self.cap:
                             break
+            if expired or shed or batch:
+                # freed rows: wake block-policy submitters and the
+                # stop(drain_s) wait
+                self._cond.notify_all()
         for r in expired:
             r.future._set_exception(ServeTimeout(
                 "request %d expired after %.0f ms in queue"
                 % (r.rid, (now - r.t_in) * 1e3)))
+        for r in shed:
+            r.future._set_exception(ServeTimeout(
+                "request %d shed: remaining deadline %.0f ms < EWMA "
+                "batch latency %.0f ms — it would expire in flight"
+                % (r.rid, (r.deadline - now) * 1e3, ewma * 1e3)))
         return batch
 
     def _bucket_for(self, total: int) -> Optional[int]:
@@ -596,16 +912,21 @@ class ModelServer:
             feed = {n: jax.device_put(
                 v, batch_sharding(self.mesh, np.ndim(v)))
                 for n, v in feed.items()}
+        t_run = time.perf_counter()
         try:
+            # batch_error: the injectable whole-batch failure (a wedged
+            # executable, a poisoned weight buffer) that drives the
+            # circuit breaker in tests — MXTPU_FAULTS
+            # "batch_error@model=NAME:count=K"
+            if _faults.hit("batch_error", model=m.name):
+                raise ServeError("injected batch_error (model %r)"
+                                 % m.name)
             outs = m.cf.run(m.params, m.aux, feed)
             outs_np = [np.asarray(o) for o in outs]
         except Exception as e:                        # noqa: BLE001
-            with self._cond:
-                self._stats["failed"] += len(batch)
-            for r in batch:
-                r.future._set_exception(ServeError(
-                    "batched forward failed: %s" % e))
+            self._batch_failed(m, batch, e)
             return
+        m.cf.record_latency(padded, time.perf_counter() - t_run)
         with self._cond:
             if _tsan.TSAN:
                 _tsan.note_write("serving.ModelServer.stats")
@@ -615,10 +936,31 @@ class ModelServer:
             occ = self._occupancy.setdefault(padded, [0, 0])
             occ[0] += 1
             occ[1] += total
+            m.batches += 1
+            m.sheds_since_batch = 0    # a batch ran: fresh EWMA evidence
+            # breaker success: a served batch closes a half-open
+            # breaker and resets the consecutive-failure count
+            m.consec_failures = 0
+            if m.breaker == "half_open":
+                m.breaker = "closed"
+                m.opened_at = None
+        now = time.perf_counter()
         off = 0
         for r in batch:
             rows = [o[off:off + r.n] for o in outs_np]
             off += r.n
+            if r.deadline is not None and r.deadline < now:
+                # expiry re-checked AFTER compute: a late result fails
+                # its future honestly instead of pretending the
+                # deadline held (the client has already moved on)
+                with self._cond:
+                    self._stats["expired_after_dispatch"] += 1
+                    self._stats["failed"] += 1
+                r.future._set_exception(ServeTimeout(
+                    "request %d expired in flight: result ready %.0f ms "
+                    "past its deadline" % (r.rid,
+                                           (now - r.deadline) * 1e3)))
+                continue
             bad = self.validate and any(
                 jnp.issubdtype(o.dtype, jnp.floating)
                 and not np.all(np.isfinite(o)) for o in rows)
@@ -632,6 +974,40 @@ class ModelServer:
             else:
                 r.future._set_result(rows)
 
+    def _batch_failed(self, m: _Model, batch: List[_Request], exc) -> None:
+        """Whole-batch failure: fail the batch's futures, feed the
+        circuit breaker.  ``breaker_k`` consecutive failures (or ONE
+        failed half-open probe) open it — the model's queue is flushed
+        and new submits fail fast with ServeUnavailable until the
+        cool-down admits a probe.  Other tenants are untouched."""
+        flushed = []
+        with self._cond:
+            if _tsan.TSAN:
+                _tsan.note_write("serving.ModelServer.queue")
+                _tsan.note_write("serving.ModelServer.stats")
+            self._stats["failed"] += len(batch)
+            self._stats["batch_failures"] += 1
+            m.consec_failures += 1
+            if self.breaker_k and (
+                    m.breaker == "half_open"
+                    or (m.breaker == "closed"
+                        and m.consec_failures >= self.breaker_k)):
+                m.breaker = "open"
+                m.opened_at = time.perf_counter()
+                while m.queue:
+                    flushed.append(m.queue.popleft())
+                m.pending = 0
+                self._stats["failed"] += len(flushed)
+            self._cond.notify_all()
+        for r in batch:
+            r.future._set_exception(ServeError(
+                "batched forward failed: %s" % exc))
+        for r in flushed:
+            r.future._set_exception(ServeUnavailable(
+                "model %r circuit breaker opened while this request "
+                "was queued (%d consecutive batch failures)"
+                % (m.name, m.consec_failures)))
+
     # ------------------------------------------------------------------
     # observability
     def stats(self) -> Dict:
@@ -640,6 +1016,7 @@ class ModelServer:
         ``_cond`` (the scheduler mutates them mid-cycle), each compiled
         forward's trace counters under ITS lock (``cf.counts()``; a
         concurrent lazy trace bumps them from another thread)."""
+        now = time.perf_counter()
         with self._cond:
             if _tsan.TSAN:
                 _tsan.note_read("serving.ModelServer.stats")
@@ -649,11 +1026,40 @@ class ModelServer:
                             "mean_fill": round(v[1] / (v[0] * b), 3)}
                    for b, v in sorted(self._occupancy.items())}
             depth = sum(len(m.queue) for m in self._models.values())
+            crashed = self._crashed
+            per_model = {}
+            for name in sorted(self._models):
+                m = self._models[name]
+                per_model[name] = {
+                    "queue_depth_rows": m.pending,
+                    "queue_depth": len(m.queue),
+                    "oldest_wait_ms": round(
+                        (now - m.queue[0].t_in) * 1e3, 3)
+                    if m.queue else 0.0,
+                    "breaker_state": m.breaker,
+                    "consec_failures": m.consec_failures,
+                    "batches": m.batches,
+                }
+        # the latency EWMA lives under each CompiledForward's own lock;
+        # read it AFTER releasing _cond (never nest the two)
+        for name, pm in per_model.items():
+            cf = self._models[name].cf
+            ewma = cf.expected_latency_s()
+            pm["ewma_batch_ms"] = None if ewma is None \
+                else round(ewma * 1e3, 3)
+            pm["latency_ms_by_bucket"] = cf.latency_ms_by_bucket()
         s["occupancy"] = occ
         s["padding_frac"] = round(
             1.0 - s["rows_real"] / s["rows_padded"], 4) \
             if s["rows_padded"] else 0.0
         s["queue_depth"] = depth
+        s["per_model"] = per_model
+        s["scheduler_crashed"] = bool(crashed)
+        s["policy"] = {"queue_cap": self.queue_cap,
+                       "shed_policy": self.shed_policy,
+                       "breaker_k": self.breaker_k,
+                       "breaker_cooldown_ms": round(
+                           self.breaker_cooldown_s * 1e3, 1)}
         s["buckets"] = list(self.buckets)
         counts = [cf.counts() for cf, _ in self._cf_groups()]
         s["aot_compiles"] = sum(c["aot"] for c in counts)

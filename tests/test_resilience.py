@@ -307,6 +307,50 @@ def test_retry_io_backoff_bounds():
                             attempts=2, delay=0.001)
 
 
+def test_retry_io_decorrelated_jitter(monkeypatch):
+    """The backoff sequence carries DECORRELATED jitter: each sleep is
+    the previous actual sleep times backoff, perturbed ±jitter — pinned
+    here with a seeded RNG; and two 'ranks' with different seeds
+    desynchronize instead of retrying in lockstep."""
+    import random
+    sleeps = []
+    monkeypatch.setattr(resilience.time, "sleep", sleeps.append)
+
+    def fail():
+        raise OSError("transient")
+
+    with pytest.raises(OSError):
+        resilience.retry_io(fail, attempts=4, delay=0.05, backoff=2.0,
+                            jitter=0.1, rng=random.Random(7))
+    # replicate the exact decorrelated recurrence with the same seed
+    ref_rng, expect, wait = random.Random(7), [], None
+    for _ in range(3):
+        wait = 0.05 if wait is None else wait * 2.0
+        wait *= 1.0 + 0.1 * (2.0 * ref_rng.random() - 1.0)
+        expect.append(wait)
+    assert sleeps == pytest.approx(expect)
+    # perturbations COMPOUND (sleep k feeds sleep k+1): strictly
+    # exponential envelope, never the bare lockstep sequence
+    assert all(abs(s - b) > 1e-9
+               for s, b in zip(sleeps, (0.05, 0.1, 0.2)))
+
+    # a second rank, different seed: every sleep differs — no lockstep
+    sleeps2 = []
+    monkeypatch.setattr(resilience.time, "sleep", sleeps2.append)
+    with pytest.raises(OSError):
+        resilience.retry_io(fail, attempts=4, delay=0.05, backoff=2.0,
+                            jitter=0.1, rng=random.Random(11))
+    assert all(abs(a - b) > 1e-9 for a, b in zip(sleeps, sleeps2))
+
+    # jitter=0 restores the exact deterministic ladder
+    sleeps3 = []
+    monkeypatch.setattr(resilience.time, "sleep", sleeps3.append)
+    with pytest.raises(OSError):
+        resilience.retry_io(fail, attempts=4, delay=0.05, backoff=2.0,
+                            jitter=0)
+    assert sleeps3 == pytest.approx([0.05, 0.1, 0.2])
+
+
 # ======================================================================
 # checkpoint manager
 def test_checkpoint_manager_latest_skips_corrupt(tmp_path):

@@ -26,9 +26,27 @@ the end: one poisoned and a few slow requests inside a burst, showing
 graceful degradation — the poisoned future fails alone, the slow
 requests stretch only their own cycles.
 
-``--out INFER_BENCH.json`` merges a ``serving`` section into the
-artifact (field definitions: docs/how_to/perf.md "Serving");
-``bench.py`` embeds the quick sweep via :func:`serving_probe`.
+**Overload sweep** (:func:`overload_probe`): offered load from 1x to 8x
+the single-request capacity against a server with admission control ON
+(bounded queue, ``reject`` shedding, per-request deadline), reporting
+per load factor
+
+* ``goodput_rps`` — completions *within their deadline* per second
+  (a late answer is not goodput; the client already gave up),
+* ``shed_rate`` — the fraction the server said *no* to (fast
+  ``ServeOverload`` rejects + deadline sheds + in-flight expiries),
+* ``p99_ms`` over the ACCEPTED completions.
+
+The degradation invariant — goodput at the highest overload >= 0.9x
+goodput at 1x — is what "graceful" means quantitatively: past
+saturation the server sheds the excess deliberately and keeps serving
+at capacity instead of letting queues and p99 grow without bound.
+``bench.py`` asserts it on every run.
+
+``--out INFER_BENCH.json`` merges ``serving`` and ``overload`` sections
+into the artifact (field definitions: docs/how_to/perf.md "Serving");
+``bench.py`` embeds the quick sweeps via :func:`serving_probe` /
+:func:`overload_probe`.
 """
 from __future__ import annotations
 
@@ -132,24 +150,45 @@ def _mixed_payloads(example, rows_mix, count, seed):
     return [rng.randn(int(s), *example).astype("f") for s in sizes]
 
 
-def poisson_run(server, payloads, rate_rps, model=None, seed=2):
-    """Open-loop Poisson arrivals at ``rate_rps`` requests/s: the
-    arrival schedule is fixed up front and honored regardless of how
-    far behind the server falls."""
+def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
+                      shed_exceptions=()):
+    """The shared open-loop arrival engine: a Poisson schedule fixed up
+    front and honored regardless of how far behind the server falls.
+    Submits shed with one of ``shed_exceptions`` are counted (and
+    timed) instead of raised.  Returns
+    ``(futures, rejected, reject_max_ms, submit_elapsed_s, t0)``."""
     rng = np.random.RandomState(seed)
     arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
                                          size=len(payloads)))
-    futures = [None] * len(payloads)
+    futures = []
+    rejected, reject_max_ms = 0, 0.0
     t0 = time.perf_counter()
     i = 0
     while i < len(payloads):
         now = time.perf_counter() - t0
         while i < len(payloads) and arrivals[i] <= now:
-            futures[i] = server.submit(data=payloads[i], model=model)
+            ts = time.perf_counter()
+            try:
+                futures.append(server.submit(data=payloads[i],
+                                             model=model))
+            except shed_exceptions:
+                rejected += 1
+                reject_max_ms = max(
+                    reject_max_ms, (time.perf_counter() - ts) * 1e3)
             i += 1
         if i < len(payloads):
             time.sleep(min(0.002, max(0.0, arrivals[i]
                                       - (time.perf_counter() - t0))))
+    return (futures, rejected, reject_max_ms,
+            time.perf_counter() - t0, t0)
+
+
+def poisson_run(server, payloads, rate_rps, model=None, seed=2):
+    """Open-loop Poisson arrivals at ``rate_rps`` requests/s (a shed —
+    possible since queues are bounded by default — propagates: this
+    sweep stays at loads the server keeps up with)."""
+    futures, _, _, _, t0 = _open_loop_submit(server, payloads, rate_rps,
+                                             model=model, seed=seed)
     ok, failed, lat = 0, 0, []
     for f in futures:
         try:
@@ -176,6 +215,123 @@ def poisson_run(server, payloads, rate_rps, model=None, seed=2):
             "max_ms": round(float(lat_ms[-1]), 3),
         })
     return out
+
+
+def overload_run(server, payloads, rate_rps, deadline_s, model=None,
+                 seed=2):
+    """Open-loop arrivals at ``rate_rps`` against a server with
+    admission control on.  A submit the server sheds
+    (:class:`ServeOverload` / :class:`ServeUnavailable`) counts as a
+    fast rejection — the whole point is that saying *no* takes
+    microseconds; ``reject_max_ms`` records the slowest one."""
+    from mxnet_tpu.serving import ServeOverload, ServeUnavailable
+
+    futures, rejected, reject_max_ms, submit_elapsed, t0 = \
+        _open_loop_submit(server, payloads, rate_rps, model=model,
+                          seed=seed,
+                          shed_exceptions=(ServeOverload,
+                                           ServeUnavailable))
+    good, late, failed, lat = 0, 0, 0, []
+    for f in futures:
+        try:
+            f.result(timeout=60)
+            lat.append(f.latency_s)
+            if f.latency_s <= deadline_s:
+                good += 1
+            else:
+                late += 1
+        except Exception:                          # noqa: BLE001
+            failed += 1                            # shed/expired in queue
+    elapsed = time.perf_counter() - t0
+    n = len(payloads)
+    out = {
+        "offered_rps": round(rate_rps, 1),
+        # the open loop can only offer as fast as one thread submits;
+        # report what was actually put on the wire so a saturated
+        # producer is visible, not silently flattering
+        "arrived_rps": round(n / submit_elapsed, 1),
+        "requests": n,
+        "accepted": len(futures),
+        "rejected_at_submit": rejected,
+        "reject_max_ms": round(reject_max_ms, 3),
+        "completed_in_deadline": good,
+        "completed_late": late,
+        "failed": failed,
+        "goodput_rps": round(good / elapsed, 1),
+        "shed_rate": round((rejected + failed + late) / n, 4),
+    }
+    if lat:
+        lat_ms = np.sort(np.asarray(lat)) * 1e3
+        out.update({
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        })
+    return out
+
+
+def overload_probe(network="mlp", quick=True, buckets=None,
+                   load_factors=None, seed=0):
+    """Goodput-under-overload sweep: offered load 1x-8x capacity with
+    the ``reject`` shedding policy, a bounded queue, and a per-request
+    deadline.  Returns the INFER_BENCH ``overload`` section, including
+    the degradation verdict (goodput at max load >= 0.9x goodput at
+    1x) that ``bench.py`` asserts."""
+    from mxnet_tpu import serving
+
+    sym, args, aux, example = build_model(network, seed)
+    load_factors = sorted(load_factors or (1.0, 2.0, 4.0, 8.0))
+    n_base = 120 if quick else 300
+    per_load = 250 if quick else 1000
+    deadline_ms = 250          # generous at 1x even on a loaded host;
+    queue_cap = 64             # ~2 full batches of backlog bounds p99
+
+    base = single_request_baseline(sym, args, aux, example, n=n_base)
+    cap = base["rps"]
+
+    loads = []
+    for f in load_factors:
+        server = serving.ModelServer(
+            buckets=buckets, queue_cap=queue_cap, shed_policy="reject",
+            timeout_ms=deadline_ms)
+        server.add_model("m", sym, args, aux,
+                         input_shapes={"data": example})
+        with server:
+            rng = np.random.RandomState(seed + int(f * 10))
+            payloads = [rng.randn(1, *example).astype("f")
+                        for _ in range(per_load)]
+            run = overload_run(server, payloads,
+                               rate_rps=max(1.0, f * cap),
+                               deadline_s=deadline_ms / 1e3)
+            server.assert_no_retrace()
+            st = server.stats()
+        run["load_factor"] = f
+        run["shed_deadline"] = st["shed_deadline"]
+        run["expired_after_dispatch"] = st["expired_after_dispatch"]
+        loads.append(run)
+    # the degradation baseline is the 1x run when swept (the honest
+    # "at capacity" anchor); with custom factors the lowest one is the
+    # baseline and base_load_factor says so — never mislabeled as 1x
+    base_f = 1.0 if 1.0 in load_factors else load_factors[0]
+    g1 = next(r["goodput_rps"] for r in loads
+              if r["load_factor"] == base_f)
+    gmax = loads[-1]["goodput_rps"]
+    return {
+        "network": network,
+        "policy": {"shed_policy": "reject", "queue_cap_rows": queue_cap,
+                   "deadline_ms": deadline_ms},
+        "single_request_rps": cap,
+        "loads": loads,
+        "base_load_factor": base_f,
+        "goodput_base_rps": g1,
+        "goodput_max_load_rps": gmax,
+        "max_load_factor": load_factors[-1],
+        "degradation_ratio": round(gmax / g1, 3) if g1 else None,
+        # the invariant: past saturation goodput stays FLAT (>= 0.9x
+        # the 1x goodput) because the excess is shed at admission, not
+        # queued into everyone's p99
+        "degradation_ok": bool(g1 and gmax >= 0.9 * g1),
+        "retraces": 0,         # assert_no_retrace() passed per factor
+    }
 
 
 def fault_demo(server, example, model=None, n=12, seed=3):
@@ -262,8 +418,10 @@ def main(argv=None):
     ap.add_argument("--rows-mix", default="1,2,4",
                     help="comma request row counts to mix")
     ap.add_argument("--out", default=None,
-                    help="merge a 'serving' section into this "
-                         "INFER_BENCH.json artifact")
+                    help="merge 'serving' + 'overload' sections into "
+                         "this INFER_BENCH.json artifact")
+    ap.add_argument("--no-overload", action="store_true",
+                    help="skip the goodput-under-overload sweep")
     args = ap.parse_args(argv)
 
     buckets = [int(b) for b in args.buckets.split(",")] \
@@ -272,19 +430,39 @@ def main(argv=None):
         network=args.network, quick=args.quick, buckets=buckets,
         rows_mix=tuple(int(r) for r in args.rows_mix.split(",")))
     import jax
-    section["device"] = "%s (%s)" % (jax.devices()[0].device_kind,
-                                     jax.default_backend())
+    device = "%s (%s)" % (jax.devices()[0].device_kind,
+                          jax.default_backend())
+    section["device"] = device
     print(json.dumps(section, indent=1))
+    overload = None
+    if not args.no_overload:
+        overload = overload_probe(network=args.network,
+                                  quick=args.quick, buckets=buckets)
+        overload["device"] = device
+        print(json.dumps(overload, indent=1))
+        if not overload["degradation_ok"]:
+            print("overload degradation invariant FAILED: goodput at "
+                  "%sx (%.1f rps) < 0.9x goodput at %sx (%.1f rps)"
+                  % (overload["max_load_factor"],
+                     overload["goodput_max_load_rps"],
+                     overload["base_load_factor"],
+                     overload["goodput_base_rps"]), file=sys.stderr)
     if args.out:
         artifact = {}
         if os.path.exists(args.out):
             with open(args.out) as f:
                 artifact = json.load(f)
         artifact["serving"] = section
+        if overload is not None:
+            artifact["overload"] = overload
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=1)
             f.write("\n")
-        print("wrote serving section -> %s" % args.out, file=sys.stderr)
+        print("wrote serving%s section -> %s"
+              % ("" if overload is None else "+overload", args.out),
+              file=sys.stderr)
+    if overload is not None and not overload["degradation_ok"]:
+        return 1
     return 0
 
 
