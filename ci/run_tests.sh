@@ -150,7 +150,7 @@ timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 \
     MXTPU_TSAN_LOG="$TSAN_LOG" \
     python -m pytest tests/test_serving.py tests/test_serving_overload.py \
         tests/test_stream_pipeline.py \
-        tests/test_elastic.py -q -m "not slow"
+        tests/test_elastic.py tests/test_integrity.py -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"
 
@@ -184,6 +184,17 @@ stage "serving overload suite (admission control / breaker / drain / supervision
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serving_overload.py -q
 
+stage "state-integrity suite (fingerprint / replica vote / verified rollback)"
+# the silent-data-corruption defense: on-device checksum determinism,
+# bitflip -> vote -> rank blame on the 2-replica CPU mesh, rollback to
+# the newest checkpoint that re-hashes to its manifest fingerprint,
+# the consecutive-divergence cap, ZeRO-1 shard checksums, and the
+# keep-N carve-out for the newest verified save.  HARD timeout: a
+# wedged vote program or a rollback loop must FAIL this stage, not
+# hang the suite — docs/how_to/resilience.md "Silent data corruption"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_integrity.py -q
+
 stage "fault-injection suite (sentinel / crash-resume / io recovery)"
 # every recovery path driven on demand via MXTPU_FAULTS — step sentinel
 # skip/abort, SIGKILL-faithful torn-checkpoint resume (subprocess),
@@ -212,11 +223,12 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_elastic.py, test_resilience.py, test_serving.py,
-# test_serving_overload.py, test_stream_pipeline.py and
-# test_zero_accum.py already ran as their own stages above
+# test_elastic.py, test_integrity.py, test_resilience.py,
+# test_serving.py, test_serving_overload.py, test_stream_pipeline.py
+# and test_zero_accum.py already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_elastic.py \
+    --ignore=tests/test_integrity.py \
     --ignore=tests/test_resilience.py \
     --ignore=tests/test_serving.py \
     --ignore=tests/test_serving_overload.py \

@@ -171,6 +171,8 @@ from . import serving
 from . import faults
 from . import resilience
 from .resilience import CheckpointManager
+from . import integrity
+from .integrity import IntegrityError  # noqa: F401
 from . import health
 
 # Custom op front-ends (reference mx.nd.Custom / mx.sym.Custom)

@@ -479,6 +479,61 @@ class ElasticCoordinator:
             "fresh" % ([r for r in peers if self._read_step(r) < step],
                        step, self.barrier_attempts))
 
+    # ------------------------------------------------------- quarantine
+    def quarantine(self, rank: int, attempts: int = 3) -> Membership:
+        """Shrink ``rank`` out of the membership by POLICY rather than
+        by lapsed heartbeat — the integrity vote's outvoted replica
+        (docs/how_to/resilience.md "Silent data corruption").  Publishes
+        the next epoch without it through the same atomic commit as the
+        dead-host path, so every survivor observes ``ElasticShrink`` at
+        its next guard and the quarantined rank — which is alive and
+        heartbeating, that is the point — observes ``ElasticRevoked``
+        and exits without touching the checkpoint line.  Idempotent:
+        an already-absent rank publishes nothing.
+
+        Race-safe: ``_publish`` yields to a concurrent publisher that
+        already moved the epoch (e.g. the monitor shrinking a genuinely
+        dead peer) — unlike that path, where racing writers carry
+        identical content, losing THIS write would silently keep the
+        flaky rank in the world.  So the publish is re-read and retried
+        against the fresh record until the rank is gone."""
+        rank = int(rank)
+        for _ in range(max(1, int(attempts))):
+            mem = self.membership()
+            if rank not in mem.world:
+                return mem
+            # fold concurrently-LAPSED peers into this publish: two
+            # same-epoch writers clobber each other (atomic rename,
+            # last write wins), and unlike the dead-host path — where
+            # racing writers carry identical content — the monitor's
+            # shrink and this quarantine differ.  Removing the union
+            # makes either winner correct: if this write lands last it
+            # does not resurrect a dead peer the monitor just removed,
+            # and if the monitor's lands last the retry below re-reads
+            # and quarantines on top of it.
+            lapsed = [r for r in self._lapsed(mem) if r != rank]
+            survivors = [r for r in mem.world
+                         if r != rank and r not in lapsed]
+            if not survivors:
+                raise MXNetError(
+                    "refusing to quarantine rank %d: it is the only "
+                    "member left (epoch %d)" % (rank, mem.epoch))
+            new = Membership(mem.epoch + 1, survivors, self.num_workers,
+                             wallclock=time.time(),
+                             dead=sorted([rank] + lapsed))
+            self._publish(mem, new)
+            cur = self.membership()
+            if rank not in cur.world:
+                self.logger.warning(
+                    "rank %d: QUARANTINED rank %d (integrity outvote) — "
+                    "membership epoch %d, surviving world %s", self.rank,
+                    rank, cur.epoch, cur.world)
+                return cur
+        raise MXNetError(
+            "quarantine of rank %d kept losing the membership publish "
+            "race after %d attempts (epoch now %d, world %s)"
+            % (rank, attempts, cur.epoch, cur.world))
+
     def close(self) -> None:
         if self._own_hb:
             self._hb.stop()

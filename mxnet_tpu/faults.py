@@ -74,6 +74,23 @@ spec is installed):
   LOOP itself, outside the per-batch recovery — driving the
   supervision path: every pending future fails, the server flips to
   rejecting (``docs/how_to/serving.md`` "Overload & degradation").
+* ``bitflip`` — :meth:`Trainer.step` AFTER the fused update
+  (``step=`` 1-based update counter, ``rank=`` exact replica index on
+  the mesh ``data`` axis): XOR-flips one mantissa bit of a state leaf
+  on that replica's device copy — a finite, quiet corruption the NaN
+  sentinel cannot see, driving the integrity vote/rollback protocol
+  (``docs/how_to/resilience.md`` "Silent data corruption").  Payload
+  keys (carried to the site, never matched): ``leaf=GLOB`` picks the
+  leaf by path glob (``arg/fc1_weight``, ``opt/fc1_weight[0]``, or the
+  bare name — default ``*``: the first state leaf; only ``*``/``?``
+  are wildcards, brackets are literal, and ``/`` spells the namespace
+  colon since ``:`` separates conditions), ``bit=B`` the bit index
+  (default 12, mantissa).
+
+Condition keys are CHECKED at parse time against the registry of keys
+the injection sites actually report (`_KNOWN_KEYS`): a typo like
+``setp=3`` is a loud parse error naming the key, not a directive that
+silently never fires.
 
 Example::
 
@@ -89,8 +106,8 @@ from typing import Dict, List, Optional
 from . import _tsan
 from .base import MXNetError
 
-__all__ = ["configure", "clear", "active", "hit", "maybe_crash",
-           "fired", "injected", "InjectedCrash"]
+__all__ = ["configure", "clear", "active", "hit", "hit_params",
+           "maybe_crash", "fired", "injected", "InjectedCrash"]
 
 _ENV = "MXTPU_FAULTS"
 
@@ -102,7 +119,32 @@ _EXACT_KEYS = frozenset(("rank",))
 # identity keys whose values are STRINGS (matched exactly); every other
 # key still requires an integer — "io_error@batch=soon" stays a parse
 # error, not a directive that silently never fires
-_STRING_KEYS = frozenset(("model",))
+_STRING_KEYS = frozenset(("model", "leaf"))
+
+# payload keys: carried TO the site on a fire (hit_params) instead of
+# being matched against it — the bitflip directive's target selection.
+# Scoped per kind: a payload key on any OTHER kind is a parse error
+# (it could never be matched NOR delivered — exactly the class of
+# silently-inert condition the _KNOWN_KEYS check exists to catch)
+_PARAM_KEYS = frozenset(("leaf", "bit"))
+_PARAM_KEYS_BY_KIND = {"bitflip": _PARAM_KEYS}
+
+# every condition key some injection site actually reports (plus the
+# grammar's own count/payload keys).  _parse REJECTS anything else:
+# "setp=3" must be a loud error naming the key, not a directive that
+# silently never fires.
+_KNOWN_KEYS = frozenset((
+    "step", "batch", "beat", "save", "epoch", "request", "rank",
+    "model", "count", "leaf", "bit"))
+
+# every bare site word an injection site actually reports (``site=``
+# ctx).  _parse REJECTS anything else for the same reason as
+# _KNOWN_KEYS — in particular the tail of ``leaf=arg:fc1_weight``,
+# where ':' splits the namespaced leaf path into a bogus site word and
+# the directive would otherwise silently never fire.
+_KNOWN_SITES = frozenset((
+    "iter_next", "hb_stamp", "ckpt_write", "manifest_write",
+    "decode_worker", "sched"))
 
 
 class InjectedCrash(BaseException):
@@ -131,6 +173,8 @@ class _Directive:
             if ctx.get("site") != site:
                 return False
         for key, threshold in self.conds.items():
+            if key in _PARAM_KEYS:
+                continue            # payload, delivered on fire
             val = ctx.get(key)
             if val is None:
                 return False
@@ -165,6 +209,17 @@ def _parse(spec: str) -> List[_Directive]:
                 continue
             key, eq, val = item.partition("=")
             if eq:
+                if key not in _KNOWN_KEYS:
+                    import difflib
+                    close = difflib.get_close_matches(
+                        key, sorted(_KNOWN_KEYS), n=1)
+                    raise MXNetError(
+                        "unknown fault condition key %r in %r%s — known "
+                        "keys: %s (a typo'd key would otherwise never "
+                        "fire)" % (key, raw,
+                                   (" (did you mean %r?)" % close[0])
+                                   if close else "",
+                                   "/".join(sorted(_KNOWN_KEYS))))
                 if key in _STRING_KEYS:
                     # an identity string, matched exactly — checked
                     # BEFORE int() so a tenant literally named "2"
@@ -185,8 +240,28 @@ def _parse(spec: str) -> List[_Directive]:
                     conds[key] = ival
             elif item == "soft":
                 soft = True
+            elif item not in _KNOWN_SITES:
+                hint = ""
+                if any(k in _STRING_KEYS for k in conds):
+                    hint = (" — ':' separates conditions; inside a "
+                            "leaf glob spell the namespace colon as "
+                            "'/' (leaf=arg/fc1_weight) or use the "
+                            "bare leaf name")
+                raise MXNetError(
+                    "unknown fault site word %r in %r%s (known sites: "
+                    "%s; an unknown site would otherwise never fire)"
+                    % (item, raw, hint, "/".join(sorted(_KNOWN_SITES))))
             else:
                 sites.append(item)
+        allowed_payload = _PARAM_KEYS_BY_KIND.get(kind, frozenset())
+        for key in conds:
+            if key in _PARAM_KEYS and key not in allowed_payload:
+                raise MXNetError(
+                    "condition key %r in %r is a payload key of %s "
+                    "directives only — on %r it would neither match nor "
+                    "be delivered" % (key, raw,
+                                      "/".join(sorted(_PARAM_KEYS_BY_KIND)),
+                                      kind))
         out.append(_Directive(kind, conds, sites, count, soft))
     return out
 
@@ -234,15 +309,24 @@ def active(kind: Optional[str] = None) -> bool:
 def hit(kind: str, **ctx) -> bool:
     """Report reaching an injection site.  Returns True exactly when a
     matching directive fires (and consumes one of its ``count``)."""
+    return hit_params(kind, **ctx) is not None
+
+
+def hit_params(kind: str, **ctx) -> Optional[Dict]:
+    """Like :func:`hit`, but on a fire returns the directive's PAYLOAD
+    keys (``leaf=``/``bit=`` — carried to the site, never matched) so
+    the site knows what to corrupt.  ``{}`` means "fired, no payload";
+    ``None`` means no directive fired."""
     if not _ACTIVE and _configured:
-        return False
+        return None
     _ensure_loaded()
     with _lock:
         for d in _directives:
             if d.kind == kind and d.matches(ctx):
                 d.fired += 1
-                return True
-    return False
+                return {k: v for k, v in d.conds.items()
+                        if k in _PARAM_KEYS}
+    return None
 
 
 def fired(kind: str) -> int:

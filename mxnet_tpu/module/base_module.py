@@ -11,6 +11,7 @@ SequentialModule) provide the computation primitives.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from collections import namedtuple
 
@@ -215,11 +216,60 @@ class BaseModule(object):
         staged = self._maybe_overlap_uploads(train_data)
         wrapped = staged is not train_data
         train_data = staged
+
+        # silent-data-corruption recovery (docs/how_to/resilience.md
+        # "Silent data corruption"): the trainer's in-step integrity
+        # check raises IntegrityError on a fingerprint divergence; the
+        # loop below rolls back to the newest checkpoint whose reloaded
+        # state re-hashes to its manifest fingerprint and re-steps (a
+        # deterministic iterator reproduces the lost updates bit-for-
+        # bit, and the agreeing re-check attributes blame).  A
+        # consecutive-divergence cap turns a persistently corrupt
+        # device into a loud MXNetError instead of a rollback loop;
+        # with an elastic coordinator attached, a blamed replica is
+        # quarantined through the membership-shrink path.
+        from ..base import MXNetError
+        from ..integrity import IntegrityError
+        raw_cap = os.environ.get("MXTPU_INTEGRITY_MAX_ROLLBACKS", "3") or 3
         try:
-            for epoch in range(begin_epoch, num_epoch):
-                elapsed = self._train_epoch(epoch, train_data, eval_metric,
-                                            batch_end_callback, monitor,
-                                            elastic=elastic)
+            max_rollbacks = int(raw_cap)
+        except (TypeError, ValueError):
+            raise MXNetError(
+                "max_rollbacks (MXTPU_INTEGRITY_MAX_ROLLBACKS)=%r is "
+                "not an integer" % (raw_cap,)) from None
+        trainer = getattr(self, "_trainer", None)
+        if trainer is not None and (
+                getattr(trainer, "on_integrity_blame", None) is None or
+                getattr(trainer.on_integrity_blame, "_fit_wired", False)):
+            # blame can resolve AFTER the rollback (the replay's
+            # agreeing re-check exonerates the honest replicas on a
+            # 1-vs-1 split): quarantine from the callback too.  Rewire
+            # on EVERY fit — a wrapper left by a previous fit() holds
+            # that call's (possibly closed) coordinator — but never
+            # clobber a user-installed callback.
+            if elastic is None:
+                trainer.on_integrity_blame = None
+            else:
+                def _blame_cb(record, _elastic=elastic):
+                    self._quarantine_blamed(record, _elastic)
+                _blame_cb._fit_wired = True
+                trainer.on_integrity_blame = _blame_cb
+        rollbacks = 0
+        try:
+            epoch = begin_epoch
+            while epoch < num_epoch:
+                try:
+                    elapsed = self._train_epoch(epoch, train_data,
+                                                eval_metric,
+                                                batch_end_callback,
+                                                monitor, elastic=elastic)
+                except IntegrityError as err:
+                    rollbacks += 1
+                    epoch = self._integrity_rollback(
+                        err, ckpt_mgr, elastic, rollbacks, max_rollbacks)
+                    train_data.reset()
+                    continue
+                rollbacks = 0       # verified forward progress
                 for name, val in eval_metric.get_name_value():
                     self.logger.info("Epoch[%d] Train-%s=%f",
                                      epoch, name, val)
@@ -252,9 +302,91 @@ class BaseModule(object):
                         self.logger.info("Epoch[%d] Validation-%s=%f",
                                          epoch, name, val)
                 train_data.reset()
+                epoch += 1
         finally:
             if wrapped:
                 train_data._shutdown_worker()
+
+    def _quarantine_blamed(self, record, elastic):
+        """Shrink the process hosting every blamed replica out of the
+        elastic membership (docs/how_to/resilience.md "Silent data
+        corruption").  The outvoted rank is alive and heartbeating —
+        that is the point: policy, not a lapsed lease, removes it, so
+        the launcher relaunches the shrunk world instead of handing the
+        flaky chip more updates to corrupt.
+
+        Membership is per-PROCESS while blame is per data-axis REPLICA:
+        on a multi-process mesh each blamed replica maps to the process
+        owning its device (rank-major global meshes — a host with two
+        chips holds replicas 2h and 2h+1), so the flaky chip evicts its
+        host and never a neighbor.  On a single-process mesh (tests,
+        simulation) there is no device→process signal and the replica
+        index is used as the elastic rank directly."""
+        blamed = sorted({int(r) for r in record.get("blamed") or []})
+        trainer = getattr(self, "_trainer", None)
+        mesh = getattr(trainer, "mesh", None)
+        if mesh is not None and tuple(mesh.axis_names) == ("data",):
+            devs = list(mesh.devices.reshape(-1))
+            if len({d.process_index for d in devs}) > 1:
+                blamed = sorted({int(devs[r].process_index)
+                                 for r in blamed if r < len(devs)})
+        for rank in blamed:
+            try:
+                elastic.quarantine(rank)
+            except Exception as e:                  # noqa: BLE001
+                self.logger.warning(
+                    "integrity: quarantine of blamed rank %s failed: %s",
+                    rank, e)
+
+    def _integrity_rollback(self, err, ckpt_mgr, elastic, rollbacks,
+                            max_rollbacks):
+        """One round of the rollback-to-last-verified protocol; returns
+        the epoch index the fit loop re-enters at.  Escalates to
+        MXNetError when there is nothing trustworthy to restore or the
+        consecutive-divergence cap is hit — silent corruption must
+        never fail silently."""
+        from ..base import MXNetError
+        record = getattr(err, "record", None) or {}
+        if rollbacks > max_rollbacks:
+            raise MXNetError(
+                "integrity: %d consecutive divergences without verified "
+                "progress (MXTPU_INTEGRITY_MAX_ROLLBACKS=%d) — the "
+                "corruption recurs faster than checkpoints verify; "
+                "refusing to rollback-loop. Last divergence: %s"
+                % (rollbacks, max_rollbacks, err)) from err
+        cb = getattr(getattr(self, "_trainer", None),
+                     "on_integrity_blame", None)
+        if elastic is not None and record.get("blamed") and \
+                not getattr(cb, "_fit_wired", False):
+            # only when the fit-wired blame callback is NOT installed:
+            # that callback already quarantined this record when the
+            # trainer resolved the blame at detection time
+            self._quarantine_blamed(record, elastic)
+        if ckpt_mgr is None:
+            raise MXNetError(
+                "integrity divergence at update %s but fit() has no "
+                "checkpoint line to roll back to — pass "
+                "checkpoint=<prefix> to enable recovery: %s"
+                % (record.get("step"), err)) from err
+        ck = ckpt_mgr.latest_verified()
+        if ck is None:
+            raise MXNetError(
+                "integrity divergence at update %s and NO checkpoint "
+                "re-hashes to its manifest fingerprint — the corruption "
+                "predates the whole retained checkpoint line: %s"
+                % (record.get("step"), err)) from err
+        self.logger.warning(
+            "integrity: divergence at update %s (mode=%s, blamed=%s) — "
+            "rolling back to verified checkpoint epoch %d (step %s) and "
+            "re-stepping [rollback %d/%d]",
+            record.get("step"), record.get("mode"), record.get("blamed"),
+            ck.epoch, ck.step, rollbacks, max_rollbacks)
+        _, arg_params, aux_params = ck.load_params()
+        self.set_params(arg_params, aux_params)
+        if ck.states_path and getattr(self, "optimizer_initialized",
+                                      False):
+            self.load_optimizer_states(ck.states_path)
+        return ck.epoch
 
     def _maybe_overlap_uploads(self, train_data):
         """Wrap ``train_data`` in :class:`~mxnet_tpu.io.DeviceUploadIter`

@@ -653,6 +653,25 @@ class Module(BaseModule):
             return self._trainer.sentinel_skips
         return 0
 
+    def state_fingerprint(self):
+        """Integrity record of the training state for the checkpoint
+        manifest (docs/how_to/resilience.md "Silent data corruption").
+        Fused path: the DEVICE-computed fingerprint over params + aux +
+        optimizer state — hashed before the host/disk path could touch
+        the values.  Classic path: a host-side hash of the param
+        mirrors (arg/aux only; the per-op executors have no device
+        fingerprint program)."""
+        if self._trainer is not None:
+            return self._trainer.state_fingerprint()
+        from .. import integrity
+        assert self.binded and self.params_initialized
+        arg_params, aux_params = self.get_params()
+        named = integrity.named_state_leaves(
+            {n: v.asnumpy() for n, v in arg_params.items()},
+            {n: v.asnumpy() for n, v in aux_params.items()})
+        global_fp, leaves = integrity.host_fingerprint(named)
+        return integrity.manifest_record(global_fp, leaves, mode="host")
+
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._trainer is not None:

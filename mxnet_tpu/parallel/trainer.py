@@ -135,7 +135,9 @@ class Trainer:
                  donate_batch: Optional[bool] = None,
                  zero: Optional[int] = None,
                  grad_accum: Optional[int] = None,
-                 grad_dtype: Optional[str] = None):
+                 grad_dtype: Optional[str] = None,
+                 integrity: Optional[str] = None,
+                 integrity_period: Optional[int] = None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -269,6 +271,41 @@ class Trainer:
                 "grad_dtype=bf16 runs the backward shard_map'd over the "
                 "data axis and does not compose with tensor-parallel "
                 "param_specs yet; keep f32 grad comm for sharded params")
+        # --- silent-data-corruption defense (docs/how_to/resilience.md
+        # "Silent data corruption"): an on-device state fingerprint
+        # computed INSIDE the jitted step every `integrity_period`
+        # updates (lax.cond, so off-period steps pay nothing), with a
+        # cross-replica checksum vote on data-parallel meshes and a
+        # deterministic replay audit on a single device.  Divergence
+        # raises integrity.IntegrityError; the recovery protocol
+        # (rollback to the last VERIFIED checkpoint + re-step) lives in
+        # Module.fit / resilience.CheckpointManager.
+        if integrity is None:
+            integrity = _os.environ.get("MXTPU_INTEGRITY_MODE", "off")
+        if integrity not in ("off", "fp", "vote", "audit"):
+            raise MXNetError("unknown integrity mode %r (off|fp|vote|"
+                             "audit)" % (integrity,))
+        self.integrity = integrity
+        if integrity_period is None:
+            integrity_period = _os.environ.get("MXTPU_INTEGRITY_PERIOD",
+                                               "100")
+        self.integrity_period = _as_int(
+            integrity_period, "integrity_period (MXTPU_INTEGRITY_PERIOD)")
+        if self.integrity != "off" and self.integrity_period < 1:
+            raise MXNetError("integrity_period=%r: need >= 1"
+                             % (integrity_period,))
+        self._integ = None             # device integrity carry (fp/vote)
+        self._integ_mode = "off"       # resolved at _build
+        self._integ_paths = None       # state-leaf paths, vote column order
+        self._integ_rep_mask = None    # which columns vote (replicated)
+        self._integ_fused = False      # fingerprint rides the step program
+        self._integ_external = False   # ZeRO-1: standalone vote program
+        self._vote_fn = None           # compiled standalone vote (ZeRO-1)
+        self._fp_fn = None             # standalone fingerprint program
+        self.integrity_divergences = 0
+        self.integrity_blamed = []     # resolved blame records
+        self._integrity_pending = None  # divergence awaiting replay blame
+        self.on_integrity_blame = None  # callback(record) on resolution
         self._opt_shardings = None     # per-leaf state shardings (mesh)
         self._grad_shardings = None    # zero-sharded grad specs
         input_set = set(self.data_names) | set(self.label_names)
@@ -283,6 +320,7 @@ class Trainer:
         self._eval_fn = None
         self._batch_shardings = None
         self._lr_cache = None
+        self._step_check_fn = None     # fingerprint-fused check program
         self._key = jax.random.key(0)
 
     def _data_axis_size(self) -> int:
@@ -401,6 +439,8 @@ class Trainer:
             # recreating the state would silently zero the skip counters
             # and desync the effective update cursor every epoch
             self._sent = self._init_sentinel(self.num_update)
+        if self._integ_mode in ("fp", "vote") and self._integ is None:
+            self._integ = self._init_integ()
         return self
 
     def _init_sentinel(self, t, skips=0, scale=None):
@@ -416,6 +456,149 @@ class Trainer:
         return {"skips": jnp.int32(skips), "consec": jnp.int32(0),
                 "good": jnp.int32(0), "t": jnp.int32(t),
                 "scale": jnp.float32(scale)}
+
+    # ----------------------------------------------------- integrity
+    def _resolve_integrity(self) -> bool:
+        """Resolve the requested integrity mode against this build's
+        topology and precompute the fingerprint leaf walk.  Returns
+        True when the step carries in-step fingerprint state (fp/vote);
+        ``audit`` is host-driven (deterministic step replay) and adds
+        nothing to the step program."""
+        if self.integrity == "off":
+            self._integ_mode = "off"
+            return False
+        mode = self.integrity
+        ndata = self._data_axis_size()
+        if mode == "vote" and (
+                ndata <= 1 or self.mesh is None
+                or tuple(self.mesh.axis_names) != ("data",)):
+            # the documented single-device fallback: a deterministic
+            # replay audit (also taken on model/pipe meshes, where a
+            # data-axis replica vote has no meaning)
+            import logging as _logging
+            _logging.getLogger("mxtpu.integrity").info(
+                "integrity=vote needs a >=2-way pure-data mesh; "
+                "falling back to the deterministic replay audit")
+            mode = "audit"
+        self._integ_mode = mode
+        if mode == "audit":
+            self._integ_external = False
+            return False
+        from jax.sharding import PartitionSpec as _P
+        from .. import integrity as _integrity
+        from .optim import state_shapes as _state_shapes
+        arg_sds = {n: jax.ShapeDtypeStruct(tuple(self._arg_shapes[n]),
+                                           jnp.float32)
+                   for n in self.param_names}
+        aux_sds = {n: jax.ShapeDtypeStruct(tuple(self._aux_shapes[n]),
+                                           jnp.float32)
+                   for n in self.aux_names}
+        opt_sds = _state_shapes(self.optimizer, self.param_names,
+                                self._arg_shapes)
+        named = _integrity.named_state_leaves(arg_sds, aux_sds, opt_sds)
+        self._integ_paths = [p for p, _ in named]
+        self._integ_specs = [self._state_leaf_spec(p) or _P()
+                             for p in self._integ_paths]
+        # only REPLICATED leaves vote: ZeRO-1 shards (and any
+        # tensor-parallel leaf) hold legitimately different bits per
+        # device — they are fingerprinted per-shard for the record but
+        # sit out the agreement check
+        self._integ_rep_mask = np.array(
+            [all(e is None for e in tuple(s)) for s in self._integ_specs],
+            bool)
+        # ZeRO-1 vote runs as a STANDALONE per-period program: the
+        # zero-sharded step's partitioner is entitled to materialize a
+        # claimed-replicated operand from its shards (slice +
+        # all-gather), which rebuilds every replica's copy from the
+        # same bytes and launders a physically divergent replica into
+        # agreement before the in-step fingerprint reads it.  A program
+        # whose ONLY consumer is the manual-sharding fingerprint reads
+        # each device's own copy (tests/test_integrity.py asserts the
+        # detection).  Costs one extra dispatch per period, not per
+        # step.
+        self._integ_external = (mode == "vote" and self.zero == 1)
+        self._vote_fn = None
+        return not self._integ_external
+
+    def _init_integ(self):
+        """Fresh device-side integrity carry: the per-replica per-leaf
+        fingerprint matrix from the last check, the global content
+        fingerprint, the agreement flag, and the update counter the
+        check ran at.  ``agree`` starts true — no check has failed."""
+        rows = self._data_axis_size() if self._integ_mode == "vote" else 1
+        cols = len(self._integ_paths)
+        return {"leaf": jnp.zeros((rows, cols), jnp.uint32),
+                "global": jnp.uint32(0),
+                "agree": jnp.int32(1),
+                "step": jnp.int32(0)}
+
+    def _state_leaf_spec(self, path):
+        """PartitionSpec of a state leaf by its integrity path (None
+        without a mesh)."""
+        from jax.sharding import PartitionSpec as _P
+        if self.mesh is None:
+            return None
+        ns, _, rest = path.partition(":")
+        if ns in ("arg", "aux"):
+            return self.param_specs.get(rest, _P())
+        if self._opt_shardings is not None:
+            import jax.tree_util as jtu
+            for name, tree in self._opt_shardings.items():
+                for kp, sh in jtu.tree_flatten_with_path(tree)[0]:
+                    if "opt:%s%s" % (name, jtu.keystr(kp)) == path:
+                        return sh.spec
+        return _P()
+
+    def _make_integ_update(self):
+        """The in-step fingerprint/vote closure (traced into the fused
+        step under ``lax.cond`` on the check flag)."""
+        from jax import lax
+        from .. import integrity as _integrity
+        from .mesh import shard_map as _shard_map
+        paths = self._integ_paths
+        salts = jnp.asarray(np.array([_integrity.path_salt(p)
+                                      for p in paths], np.uint32))
+        vote_on = self._integ_mode == "vote"
+        mesh = self.mesh
+        specs = tuple(self._integ_specs)
+        rep_cols = np.where(self._integ_rep_mask)[0]
+
+        def integ_update(params, aux, opt_state, integ, check, t):
+            def compute(_):
+                named = _integrity.named_state_leaves(params, aux,
+                                                      opt_state)
+                leaves = [v for _, v in named]
+                lf = jnp.stack([_integrity.leaf_fingerprint(v)
+                                for v in leaves])
+                gfp = _integrity.fold_fingerprints(lf, salts)
+                if vote_on:
+                    def local(*vals):
+                        return jnp.stack(
+                            [_integrity.leaf_fingerprint(v)
+                             for v in vals]).reshape(1, -1)
+
+                    # each replica fingerprints ITS copy (shards: its
+                    # shard); rows stack along the data axis.  check_rep
+                    # off: divergent replicas are the signal, not a bug
+                    mat = _shard_map(
+                        local, mesh=mesh, in_specs=specs,
+                        out_specs=PartitionSpec("data", None),
+                        check_rep=False)(*leaves)
+                    if len(rep_cols):
+                        agree = jnp.all(mat[:, rep_cols]
+                                        == mat[0:1, rep_cols])
+                    else:
+                        agree = jnp.bool_(True)
+                else:
+                    mat = lf.reshape(1, -1)
+                    agree = jnp.bool_(True)
+                return {"leaf": mat, "global": gfp,
+                        "agree": agree.astype(jnp.int32),
+                        "step": jnp.asarray(t, jnp.int32)}
+
+            return lax.cond(check, compute, lambda _: integ, 0)
+
+        return integ_update
 
     def _zero_keeps_shard(self, name: str) -> bool:
         """True when ``name``'s zero-sharded grad spec owns dim 0 along
@@ -818,6 +1001,55 @@ class Trainer:
             outs, _ = _forward(params, aux_vals, batch, key, True)
             return tuple(o.astype(jnp.float32) for o in outs)
 
+        # --- integrity fingerprint + vote, fused into the step
+        # (docs/how_to/resilience.md "Silent data corruption"): every
+        # `integrity_period`-th update dispatches a check-step program
+        # that bitcasts the carried (params, aux, opt-state) leaves to
+        # uint32 and tree-folds them into per-leaf and global checksums
+        # fused with the update — one read of state bytes, no host
+        # round-trip; all other steps dispatch the plain program an
+        # unarmed trainer runs.  "vote" additionally shard_maps the per-leaf
+        # fingerprints over the data axis: replicated state must be
+        # bit-identical across replicas, so an all-gathered row per
+        # replica turns a flaky chip into a countable minority (ZeRO-1
+        # shards fingerprint per-shard and sit out the vote — shards
+        # legitimately differ).
+        integ_on = self._resolve_integrity()
+        self._integ_fused = integ_on
+        sentinel_or_plain = step_sentinel if sentinel_on else step
+        n_sent = 1 if sentinel_on else 0
+        step_check = None
+        if integ_on:
+            # TWO programs, not a lax.cond riding every call: the
+            # check-step program fuses the fingerprint with the update,
+            # and the other `period - 1` steps dispatch the SAME plain
+            # program an unarmed trainer runs — the cond variant kept
+            # the carry + flag as per-call args, a fixed ~0.2 ms of
+            # dispatch per step that dwarfs a small model's whole step
+            # (and 'off-period steps execute nothing extra' held for
+            # the device, not the host).  Costs one extra compile.
+            integ_update = self._make_integ_update()
+            n_core = 3 + n_sent
+
+            def step_check(*args):
+                integ = args[n_core]
+                batch, lr, t, key = args[n_core + 1:]
+                new_integ = integ_update(args[0], args[1], args[2],
+                                         integ, jnp.bool_(True), t)
+                core = sentinel_or_plain(*(args[:n_core]
+                                           + (batch, lr, t, key)))
+                return core[:-1] + (new_integ, core[-1])
+
+        step_fn = sentinel_or_plain
+        # donate state + sentinel; in the check program NOT the integ
+        # carry (its buffer is replaced by the check, but the replay
+        # paths re-read the pre-step carry) — batch sits one slot later
+        # there
+        donate = tuple(range(3 + n_sent)) + (
+            (3 + n_sent,) if self.donate_batch else ())
+        donate_check = tuple(range(3 + n_sent)) + (
+            (3 + n_sent + 1,) if self.donate_batch else ())
+
         if self.mesh is not None and self.mesh.size > 1:
             mesh = self.mesh
             if "data" in mesh.axis_names:
@@ -846,23 +1078,21 @@ class Trainer:
             # NEXT call's in_shardings; zero's constrained-but-unpinned
             # params came back row-sharded).  in == out == planned spec
             # keeps every donated state write a true in-place update.
-            # Sentinel scalars and the graph outputs stay unpinned.
-            zout = {"out_shardings": (p_shard, a_shard, opt_in) + (
-                (None,) if not sentinel_on else (None, None))}
-            if sentinel_on:
-                self._step_fn = jax.jit(
-                    step_sentinel,
-                    in_shardings=(p_shard, a_shard, opt_in, None,
-                                  self._batch_shardings, None, None, None),
-                    donate_argnums=(0, 1, 2, 3) + (
-                        (4,) if self.donate_batch else ()), **zout)
-            else:
-                self._step_fn = jax.jit(
-                    step,
-                    in_shardings=(p_shard, a_shard, opt_in,
-                                  self._batch_shardings, None, None, None),
-                    donate_argnums=(0, 1, 2) + (
-                        (3,) if self.donate_batch else ()), **zout)
+            # Sentinel/integrity scalars and the graph outputs stay
+            # unpinned.
+            in_core = (p_shard, a_shard, opt_in) + (None,) * n_sent
+            in_tail = (self._batch_shardings, None, None, None)
+            out_core = (p_shard, a_shard, opt_in) + (None,) * n_sent
+            self._step_fn = jax.jit(step_fn,
+                                    in_shardings=in_core + in_tail,
+                                    out_shardings=out_core + (None,),
+                                    donate_argnums=donate)
+            if step_check is not None:
+                self._step_check_fn = jax.jit(
+                    step_check,
+                    in_shardings=in_core + (None,) + in_tail,
+                    out_shardings=out_core + (None, None),
+                    donate_argnums=donate_check)
             self._eval_fn = jax.jit(
                 evaluate,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
@@ -870,16 +1100,10 @@ class Trainer:
                 evaluate_train,
                 in_shardings=(p_shard, a_shard, self._batch_shardings, None))
         else:
-            if sentinel_on:
-                self._step_fn = jax.jit(
-                    step_sentinel,
-                    donate_argnums=(0, 1, 2, 3) + (
-                        (4,) if self.donate_batch else ()))
-            else:
-                self._step_fn = jax.jit(
-                    step,
-                    donate_argnums=(0, 1, 2) + (
-                        (3,) if self.donate_batch else ()))
+            self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+            if step_check is not None:
+                self._step_check_fn = jax.jit(
+                    step_check, donate_argnums=donate_check)
             self._eval_fn = jax.jit(evaluate)
             self._eval_train_fn = jax.jit(evaluate_train)
 
@@ -942,28 +1166,62 @@ class Trainer:
         # cache the lr device scalar: one H2D per lr *change*, not per step
         if self._lr_cache is None or self._lr_cache[0] != lr:
             self._lr_cache = (lr, jnp.float32(lr))
+        # integrity check cadence (docs/how_to/resilience.md "Silent
+        # data corruption"): fp/vote fingerprint inside THIS step's
+        # program; audit replays the whole step from copied inputs
+        check_now = self._integ is not None and \
+            self.num_update % self.integrity_period == 0
+        audit_now = self._integ_mode == "audit" and \
+            self.num_update % self.integrity_period == 0
+        t_dev = jnp.int32(max(1, self.num_update))
+        if check_now and self._integ_external:
+            # ZeRO-1: the standalone vote reads THIS update's incoming
+            # state (same bits the fused check would have hashed) before
+            # the step's all-gather can launder a divergent replica
+            self._external_vote()
+            self._integrity_after_check()
+            check_now = False
+        use_check = (self._integ is not None and self._integ_fused
+                     and check_now)
+        saved = self._audit_snapshot(dev_batch) if audit_now else None
+        args = (self.params, self.aux, self.opt_state)
         if self._sent is not None:
-            (self.params, self.aux, self.opt_state, self._sent,
-             outs) = self._step_fn(
-                self.params, self.aux, self.opt_state, self._sent,
-                dev_batch, self._lr_cache[1],
-                jnp.int32(max(1, self.num_update)), key)
-            if self.sentinel == "abort":
-                # abort mode accepts the per-step device->host sync: the
-                # point IS to stop the moment K batches in a row went bad
-                consec = int(np.asarray(
-                    self._host_value(self._sent["consec"])))
-                if consec >= self.sentinel_max_skips:
-                    raise MXNetError(
-                        "step sentinel: %d consecutive non-finite "
-                        "gradient steps (threshold %d) at update %d — "
-                        "aborting (MXTPU_SENTINEL=abort)"
-                        % (consec, self.sentinel_max_skips,
-                           self.num_update))
-        else:
-            self.params, self.aux, self.opt_state, outs = self._step_fn(
-                self.params, self.aux, self.opt_state, dev_batch,
-                self._lr_cache[1], jnp.int32(max(1, self.num_update)), key)
+            args += (self._sent,)
+        if use_check:
+            args += (self._integ,)
+        args += (dev_batch, self._lr_cache[1], t_dev, key)
+        out = (self._step_check_fn if use_check else self._step_fn)(*args)
+        self.params, self.aux, self.opt_state = out[0], out[1], out[2]
+        i = 3
+        if self._sent is not None:
+            self._sent = out[i]
+            i += 1
+        if use_check:
+            self._integ = out[i]
+            i += 1
+        outs = out[i]
+        if self._sent is not None and self.sentinel == "abort":
+            # abort mode accepts the per-step device->host sync: the
+            # point IS to stop the moment K batches in a row went bad
+            consec = int(np.asarray(
+                self._host_value(self._sent["consec"])))
+            if consec >= self.sentinel_max_skips:
+                raise MXNetError(
+                    "step sentinel: %d consecutive non-finite "
+                    "gradient steps (threshold %d) at update %d — "
+                    "aborting (MXTPU_SENTINEL=abort)"
+                    % (consec, self.sentinel_max_skips,
+                       self.num_update))
+        # silent-corruption injection (docs/how_to/resilience.md): flip
+        # one mantissa bit of a state leaf on one replica's device copy
+        # AFTER the update — a corrupt HBM write the NaN sentinel can
+        # never see; the next integrity check has to notice it instead
+        if _faults.active("bitflip"):
+            self._apply_bitflip_faults()
+        if audit_now:
+            self._audit_check(saved, t_dev, key)
+        if check_now:
+            self._integrity_after_check()
         return [NDArray(self._local_rows(o)) for o in outs]
 
     def _poison_batch(self, dev_batch: Dict) -> Dict:
@@ -977,6 +1235,265 @@ class Trainer:
                 return out
         raise MXNetError("nan_grad fault: no floating input to poison "
                          "among %s" % (list(dev_batch),))
+
+    # ------------------------------------------------- integrity (host)
+    def _named_state(self):
+        from .. import integrity as _integrity
+        return _integrity.named_state_leaves(self.params, self.aux,
+                                             self.opt_state)
+
+    def _run_fp(self, named):
+        """Run the cached standalone fingerprint program over ``named``
+        (path, leaf) pairs; returns device (gfp, per-leaf) scalars."""
+        from .. import integrity as _integrity
+        salts = jnp.asarray(np.array(
+            [_integrity.path_salt(p) for p, _ in named], np.uint32))
+        if self._fp_fn is None:
+            def fp_impl(leaves, salts):
+                lf = jnp.stack([_integrity.leaf_fingerprint(v)
+                                for v in leaves])
+                return _integrity.fold_fingerprints(lf, salts), lf
+            self._fp_fn = jax.jit(fp_impl)
+        return self._fp_fn([v for _, v in named], salts)
+
+    def state_fingerprint(self) -> dict:
+        """Device-computed fingerprint of the carried (params, aux,
+        opt-state) — the record ``CheckpointManager.save`` stamps into
+        the manifest so a reloaded checkpoint can be re-hashed against
+        what the DEVICE held at save time (catching post-CRC byte
+        patches and corrupt host transfers alike).  One compiled
+        program, cached; reads L+1 scalars."""
+        from .. import integrity as _integrity
+        if self.params is None:
+            raise MXNetError("state_fingerprint needs bind()+init_params()")
+        if self._integ_mode == "vote":
+            self._save_vote_check()
+        named = self._named_state()
+        paths = [p for p, _ in named]
+        gfp, lf = self._run_fp(named)
+        lf = np.asarray(self._host_value(lf))
+        return _integrity.manifest_record(
+            int(np.asarray(self._host_value(gfp))),
+            {p: int(v) for p, v in zip(paths, lf)},
+            mode=self._integ_mode)
+
+    def _global_fp_int(self, params, aux, opt_state) -> int:
+        from .. import integrity as _integrity
+        named = _integrity.named_state_leaves(params, aux, opt_state)
+        gfp, _ = self._run_fp(named)
+        return int(np.asarray(self._host_value(gfp)))
+
+    def _save_vote_check(self):
+        """Replica agreement on the CURRENT state before a fingerprint
+        is stamped into a manifest: a corruption landing between the
+        last periodic check and an epoch-end save would otherwise be
+        hashed into a 'verified' checkpoint (host reads of a replicated
+        array take replica 0's copy, so the saved bytes and the record
+        agree with each other while the replicas do not) — and rollback
+        would then restore the corruption to EVERY replica, converting
+        a detectable divergence into a permanent silent one.  Runs the
+        same standalone program as _external_vote (a local carry: this
+        is a gate, not a periodic check — it must not touch self._integ
+        or the divergence counters)."""
+        from .. import integrity as _integrity
+        from ..integrity import IntegrityError
+        if self._vote_fn is None:
+            self._vote_fn = jax.jit(self._make_integ_update())
+        integ = self._vote_fn(
+            self.params, self.aux, self.opt_state, self._init_integ(),
+            jnp.bool_(True), jnp.int32(max(1, self.num_update)))
+        if int(np.asarray(self._host_value(integ["agree"]))):
+            return
+        mat = np.asarray(self._host_value(integ["leaf"]))
+        rep_cols = np.where(self._integ_rep_mask)[0]
+        _, blamed, div_cols = _integrity.blame_minority(mat, rep_cols)
+        raise IntegrityError(
+            "state_fingerprint REFUSED at update %d: replicas disagree "
+            "on replicated state leaf/leaves %s (blamed replica(s): %s) "
+            "— stamping this state would mint a verified-but-corrupt "
+            "checkpoint; the save stays CRC-only and the next integrity "
+            "check rolls back past it"
+            % (self.num_update,
+               [self._integ_paths[c] for c in div_cols][:4], blamed))
+
+    def _external_vote(self):
+        """The ZeRO-1 vote: a standalone compiled program whose only
+        consumer of the state is the manual-sharding fingerprint, so
+        each device provably hashes ITS copy (see _resolve_integrity —
+        the fused step's zero partitioning may rebuild a replicated
+        operand from its shards and launder the divergence).  One extra
+        dispatch per integrity period."""
+        if self._vote_fn is None:
+            self._vote_fn = jax.jit(self._make_integ_update())
+        self._integ = self._vote_fn(
+            self.params, self.aux, self.opt_state, self._integ,
+            jnp.bool_(True), jnp.int32(max(1, self.num_update)))
+
+    def _apply_bitflip_faults(self):
+        """Consume armed ``bitflip`` directives: corrupt the matched
+        state leaf on the targeted replica, on device."""
+        from .. import integrity as _integrity
+        ndata = max(1, self._data_axis_size())
+        for rank in range(ndata):
+            payload = _faults.hit_params("bitflip", step=self.num_update,
+                                         rank=rank)
+            if payload is None:
+                continue
+            pattern = str(payload.get("leaf", "*"))
+            bit = int(payload.get("bit", 12))
+            named = self._named_state()
+            f32_paths = [p for p, v in named
+                         if getattr(v, "dtype", None) == jnp.float32]
+            target = _integrity.match_leaf(pattern, f32_paths)
+            if target is None:
+                raise MXNetError(
+                    "bitflip fault: leaf glob %r matches no f32 state "
+                    "leaf (have %s%s)"
+                    % (pattern, f32_paths[:6],
+                       "..." if len(f32_paths) > 6 else ""))
+            value = dict(named)[target]
+            mesh = self.mesh if self._data_axis_size() > 1 else None
+            flipped = _integrity.bitflip(
+                value, rank, bit=bit, mesh=mesh,
+                spec=self._state_leaf_spec(target) if mesh is not None
+                else None)
+            self._set_state_leaf(target, flipped)
+            import logging as _logging
+            _logging.getLogger("mxtpu.integrity").warning(
+                "bitflip fault fired: leaf %s bit %d rank %d at update "
+                "%d", target, bit, rank, self.num_update)
+
+    def _set_state_leaf(self, path: str, value) -> None:
+        import jax.tree_util as jtu
+        ns, _, rest = path.partition(":")
+        if ns == "arg":
+            self.params[rest] = value
+            return
+        if ns == "aux":
+            self.aux[rest] = value
+            return
+        for name in self.opt_state:
+            flat, treedef = jtu.tree_flatten(self.opt_state[name])
+            with_path = jtu.tree_flatten_with_path(
+                self.opt_state[name])[0]
+            for i, (kp, _) in enumerate(with_path):
+                if "opt:%s%s" % (name, jtu.keystr(kp)) == path:
+                    flat[i] = value
+                    self.opt_state[name] = jtu.tree_unflatten(treedef,
+                                                              flat)
+                    return
+        raise MXNetError("no state leaf at %r" % (path,))
+
+    def _audit_snapshot(self, dev_batch):
+        """On-device copies of everything the step consumes — the
+        ``(params, batch, rng)`` the deterministic replay re-runs from.
+        Copies, not aliases: the step donates its inputs."""
+        copy = jax.tree.map(jnp.copy, (
+            self.params, self.aux, self.opt_state,
+            self._sent if self._sent is not None else {}))
+        batch = {n: jnp.copy(v) for n, v in dev_batch.items()} \
+            if self.donate_batch else dev_batch
+        return copy + (batch,)
+
+    def _audit_check(self, saved, t_dev, key):
+        """The single-device audit: re-execute the step from the saved
+        inputs and compare output-state fingerprints.  XLA programs are
+        deterministic, so ANY difference — a flaky ALU, a corrupt HBM
+        write (or the injected ``bitflip``) — is a divergence."""
+        from ..integrity import IntegrityError
+        s_params, s_aux, s_opt, s_sent, s_batch = saved
+        args = (s_params, s_aux, s_opt)
+        if self._sent is not None:
+            args += (s_sent,)
+        args += (s_batch, self._lr_cache[1], t_dev, key)
+        out = self._step_fn(*args)
+        fp_live = self._global_fp_int(self.params, self.aux,
+                                      self.opt_state)
+        fp_replay = self._global_fp_int(out[0], out[1], out[2])
+        if fp_live == fp_replay:
+            return
+        record = {"step": int(self.num_update), "mode": "audit",
+                  "world": 1, "fps": [[fp_live], [fp_replay]],
+                  "leaves": [], "blamed": None}
+        self.integrity_divergences += 1
+        raise IntegrityError(
+            "integrity audit: update %d executed twice from identical "
+            "inputs produced different state fingerprints (%08x vs "
+            "replay %08x) — silent corruption during execution; roll "
+            "back to the last verified checkpoint"
+            % (self.num_update, fp_live, fp_replay), record)
+
+    def _integrity_after_check(self):
+        """Host half of a fp/vote check step: read the (tiny) agree
+        flag; on disagreement build the divergence record, blame the
+        strict minority when one exists, and raise.  On an AGREEING
+        check that replays a previously recorded divergence step, close
+        the loop: the replica whose recorded fingerprints match the
+        honest replay is exonerated, the rest are blamed (this is how a
+        1-vs-1 split — two replicas, no majority — gets attributed)."""
+        from .. import integrity as _integrity
+        agree = bool(int(np.asarray(
+            self._host_value(self._integ["agree"]))))
+        pend = self._integrity_pending
+        if agree:
+            if pend is not None and pend.get("mode") == "vote" \
+                    and pend.get("step") == self.num_update:
+                self._integrity_pending = None
+                mat = np.asarray(self._host_value(self._integ["leaf"]))
+                rep = np.where(self._integ_rep_mask)[0]
+                fresh = [int(v) for v in mat[0][rep]]
+                rows = pend.get("fps") or []
+                exonerated = [
+                    r for r in range(len(rows))
+                    if [int(rows[r][c]) for c in rep] == fresh]
+                blamed = sorted(set(range(len(rows)))
+                                - set(exonerated)) if exonerated else None
+                pend["blamed"] = blamed
+                import logging as _logging
+                log = _logging.getLogger("mxtpu.integrity")
+                if blamed:
+                    self.integrity_blamed.append(pend)
+                    log.warning(
+                        "integrity: rollback replay of update %d "
+                        "matches replica(s) %s — BLAMING replica(s) %s "
+                        "for the recorded divergence (leaves %s)",
+                        self.num_update, exonerated, blamed,
+                        pend.get("leaves"))
+                    if self.on_integrity_blame is not None:
+                        self.on_integrity_blame(pend)
+                else:
+                    log.warning(
+                        "integrity: rollback replay of update %d "
+                        "matches no recorded replica — blame "
+                        "indeterminate (corruption predated the check "
+                        "window)", self.num_update)
+            return
+        from ..integrity import IntegrityError
+        mat = np.asarray(self._host_value(self._integ["leaf"]))
+        rep_cols = np.where(self._integ_rep_mask)[0]
+        _, blamed, div_cols = _integrity.blame_minority(mat, rep_cols)
+        record = {"step": int(self.num_update), "mode": "vote",
+                  "world": int(mat.shape[0]),
+                  "fps": [[int(v) for v in row] for row in mat],
+                  "leaves": [self._integ_paths[c] for c in div_cols],
+                  "blamed": blamed}
+        self.integrity_divergences += 1
+        if blamed is not None:
+            self.integrity_blamed.append(record)
+            if self.on_integrity_blame is not None:
+                self.on_integrity_blame(record)
+            self._integrity_pending = None
+        else:
+            # no strict majority (e.g. 2 replicas): the rollback replay
+            # of this step resolves attribution — see the agree branch
+            self._integrity_pending = record
+        raise IntegrityError(
+            "integrity vote FAILED at update %d: replicas disagree on "
+            "%d replicated state leaf/leaves %s — blamed replica(s): "
+            "%s; roll back to the last verified checkpoint and re-step"
+            % (self.num_update, len(div_cols), record["leaves"][:4],
+               blamed if blamed is not None else
+               "indeterminate (no strict majority)"), record)
 
     @property
     def sentinel_skips(self) -> int:
@@ -1061,6 +1578,11 @@ class Trainer:
                 # blob predates the sentinel: seed the effective update
                 # counter from num_update (no skips recorded)
                 self._sent = self._init_sentinel(num_update)
+        if self._integ_mode in ("fp", "vote"):
+            # restored state invalidates the carried fingerprints; a
+            # PENDING divergence record survives on the host so the
+            # rollback replay can still resolve blame
+            self._integ = self._init_integ()
         cur = self.opt_state
 
         def _restore(sharding, c, n):

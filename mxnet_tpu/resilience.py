@@ -17,6 +17,15 @@ adds the rest of the contract:
   verifies every listed artifact against its recorded CRC/size, and
   falls back past truncated/corrupt/incomplete candidates to the newest
   checkpoint that checks out.
+* :func:`CheckpointManager.latest_verified` — the silent-data-
+  corruption tier above ``latest`` (docs/how_to/resilience.md "Silent
+  data corruption"): every save also stamps the DEVICE-computed state
+  fingerprint (``integrity.py``) into the manifest, and this scan
+  additionally re-hashes the reloaded values against it.  A CRC guards
+  the bytes ON DISK from the moment they landed; the fingerprint guards
+  the VALUES from the moment the accelerator held them — a corrupt
+  host transfer, a byte-patch with a re-hashed CRC, or a flaky-chip
+  save all pass ``latest`` and fail here.
 * :func:`retry_io` — bounded retry-with-backoff (decorrelated jitter,
   so concurrent ranks retrying the same shared-dir fault desynchronize
   instead of hammering it in lockstep) for transient iterator and
@@ -193,6 +202,33 @@ class CheckpointManager:
             files[os.path.basename(symbol_file)] = {"crc32": crc,
                                                     "size": size}
         trainer = getattr(module, "_trainer", None)
+        # device-computed state fingerprint (integrity.py): what the
+        # ACCELERATOR held at save time, hashed before the host/disk
+        # path could corrupt it.  latest_verified() re-hashes reloaded
+        # values against this — the CRC above only guards the bytes
+        # after they landed.
+        integ = None
+        fp = getattr(module, "state_fingerprint", None)
+        if callable(fp):
+            from .integrity import IntegrityError
+            try:
+                integ = fp()
+            except IntegrityError as e:
+                # replicas disagree on the state being saved: stamping
+                # it would mint a verified-but-corrupt rollback floor.
+                # An EXPLICIT refusal record — a missing record verifies
+                # vacuously (legacy saves), this one must never verify
+                integ = {"refused": str(e)}
+                self.logger.warning(
+                    "checkpoint %04d: state DIVERGED at save — "
+                    "deliberately left unverified (CRC-manifested "
+                    "only); the next integrity check will roll back "
+                    "past it: %s", epoch, e)
+            except Exception as e:                  # noqa: BLE001
+                self.logger.warning(
+                    "checkpoint %04d: state fingerprint unavailable "
+                    "(%s) — save still CRC-manifested, but it cannot "
+                    "pass latest_verified()", epoch, e)
         manifest = {
             "version": _MANIFEST_VERSION,
             "epoch": int(epoch),
@@ -213,6 +249,7 @@ class CheckpointManager:
             "rng": {"impl": "fold_in(key(0), num_update)"},
             "wallclock": time.time(),
             "files": files,
+            "integrity": integ,
         }
         self._retry(lambda: self._write_manifest(epoch, manifest),
                     "manifest write")
@@ -289,14 +326,115 @@ class CheckpointManager:
                 return ck
         return None
 
+    def verify_fingerprint(self, ck: Checkpoint) -> bool:
+        """Re-hash ``ck``'s reloaded VALUES against the device-computed
+        fingerprint its manifest recorded at save time
+        (docs/how_to/resilience.md "Silent data corruption").
+
+        The CRC pass (:meth:`verify`) answers "are these the bytes the
+        manifest writer read back off disk?"; this pass answers "are
+        these the values the ACCELERATOR held when it saved?" — a
+        corrupt device→host transfer, a flaky-chip save, or a byte
+        patch whose author also re-hashed the manifest CRC all pass the
+        first and fail here.  Params and aux re-hash from the params
+        file; ``opt:`` leaves re-hash from the unpickled states blob.
+        A manifest without an integrity record (pre-integrity saves,
+        or a module that could not fingerprint) verifies vacuously —
+        the record is evidence, and absent evidence is not damage."""
+        from . import integrity as _integrity
+        import numpy as np
+        record = (ck.manifest or {}).get("integrity")
+        if not record:
+            return True
+        if record.get("refused"):
+            # the saver itself refused to fingerprint this state
+            # (replica vote failed at save): never a rollback target
+            self.logger.warning(
+                "checkpoint %04d recorded a REFUSED fingerprint (state "
+                "diverged at save): %s", ck.epoch, record["refused"])
+            return False
+        try:
+            _, arg_params, aux_params = ck.load_params()
+        except Exception as e:                      # noqa: BLE001
+            self.logger.warning(
+                "checkpoint %04d fails fingerprint verification: params "
+                "unreadable (%s)", ck.epoch, e)
+            return False
+
+        def host(v):
+            return np.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                              else v)
+
+        named = _integrity.named_state_leaves(
+            {n: host(v) for n, v in arg_params.items()},
+            {n: host(v) for n, v in aux_params.items()})
+        if any(p.startswith("opt:") for p in record.get("leaves", {})):
+            # the record covers optimizer state: rebuild those leaves
+            # from the states blob (the fused trainer's pickle of
+            # ``(num_update, state[, sentinel])`` — get_opt_states)
+            states = ck.states_path
+            if states is None:
+                self.logger.warning(
+                    "checkpoint %04d fails fingerprint verification: "
+                    "manifest records opt-state fingerprints but no "
+                    "states file", ck.epoch)
+                return False
+            try:
+                import pickle
+                with open(states, "rb") as f:
+                    state = pickle.loads(f.read())[1]
+                named += _integrity.named_state_leaves(opt_state=state)
+            except Exception as e:                  # noqa: BLE001
+                self.logger.warning(
+                    "checkpoint %04d fails fingerprint verification: "
+                    "states blob unreadable (%s)", ck.epoch, e)
+                return False
+        return _integrity.verify_manifest_record(
+            record, named, logger=self.logger,
+            what="checkpoint %04d" % ck.epoch)
+
+    def latest_verified(self) -> Optional[Checkpoint]:
+        """Newest checkpoint that passes BOTH tiers — artifact CRCs
+        (:meth:`verify`) and the value fingerprint
+        (:meth:`verify_fingerprint`).  The rollback target of the
+        silent-data-corruption recovery protocol: a divergence detected
+        by the in-step integrity check restores from HERE, never from a
+        checkpoint whose own state cannot prove it predates the
+        corruption."""
+        from .model import _sweep_stale_tmp
+        _sweep_stale_tmp(self.prefix)
+        for epoch in reversed(self._epochs_on_disk()):
+            ck = self.verify(epoch)
+            if ck is not None and self.verify_fingerprint(ck):
+                return ck
+        return None
+
     # ---------------------------------------------------------- prune
     def _prune(self):
         """Retention: drop everything older than the newest ``keep``
-        manifests (params + states + manifest per dropped epoch)."""
+        manifests (params + states + manifest per dropped epoch) —
+        EXCEPT the newest fully-verified checkpoint, which survives
+        rotation unconditionally.  Without the carve-out, ``keep`` new
+        saves from an already-corrupt device would rotate out the last
+        state anyone can roll back to; with it, the recovery protocol
+        always has a floor.  In the healthy case the newest save IS the
+        newest verified (one extra read-back per save, nothing
+        protected outside the keep window)."""
         if self.keep <= 0:
             return
         epochs = self._epochs_on_disk()
-        for epoch in epochs[:-self.keep]:
+        doomed = epochs[:-self.keep]
+        if not doomed:
+            return
+        protect = None
+        for epoch in reversed(epochs):
+            ck = self.verify(epoch)
+            if ck is not None and self.verify_fingerprint(ck):
+                protect = epoch
+                break
+        for epoch in doomed:
+            if epoch == protect:
+                continue
             for suffix in (".params", ".states", ".manifest.json"):
                 path = "%s-%04d%s" % (self.prefix, epoch, suffix)
                 try:

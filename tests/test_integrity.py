@@ -1,0 +1,638 @@
+"""Silent-data-corruption defense: on-device state fingerprints,
+cross-replica checksum voting, verified rollback
+(docs/how_to/resilience.md "Silent data corruption").
+
+Every detection path is driven by the deterministic ``bitflip`` fault —
+a finite, quiet mantissa flip the NaN sentinel can never see — on the
+virtual CPU mesh; the recovery e2e runs the full Module.fit protocol:
+detect at the next integrity period, roll back to the newest checkpoint
+that re-hashes to its manifest fingerprint, re-step bit-for-bit, and
+attribute blame from the agreeing replay.  All CPU-fast.
+"""
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import elastic, faults, integrity, io, parallel, resilience
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.integrity import IntegrityError
+from mxnet_tpu.parallel.trainer import Trainer
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _mlp_symbol():
+    data = mx.sym.Variable("data")
+    fc1 = mx.symbol.FullyConnected(data, name="fc1", num_hidden=16)
+    act = mx.symbol.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = mx.symbol.FullyConnected(act, name="fc2", num_hidden=4)
+    return mx.symbol.SoftmaxOutput(fc2, name="softmax")
+
+
+def _fixed_params(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"fc1_weight": rng.randn(16, 32).astype("f") * 0.1,
+            "fc1_bias": np.zeros(16, "f"),
+            "fc2_weight": rng.randn(4, 16).astype("f") * 0.1,
+            "fc2_bias": np.zeros(4, "f")}
+
+
+def _trainer(batch=8, **kw):
+    t = Trainer(_mlp_symbol(),
+                mx.optimizer.SGD(learning_rate=0.1, momentum=0.9,
+                                 rescale_grad=1.0 / batch),
+                **kw)
+    t.bind(data_shapes={"data": (batch, 32)},
+           label_shapes={"softmax_label": (batch,)})
+    t.init_params(arg_params={k: mx.nd.array(v)
+                              for k, v in _fixed_params().items()})
+    return t
+
+
+def _batches(n=10, batch=8, seed=1):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(batch, 32).astype("f"),
+             rng.randint(0, 4, batch).astype("f")) for _ in range(n)]
+
+
+def _feed(t, x, y):
+    return t.step({"data": mx.nd.array(x), "softmax_label": mx.nd.array(y)})
+
+
+def _mesh(n):
+    return parallel.make_mesh({"data": n}, jax.devices()[:n])
+
+
+# ======================================================================
+# fingerprint math
+def test_host_fingerprint_bit_sensitivity_and_permutation():
+    x = np.arange(64, dtype=np.float32)
+    fp = integrity.host_leaf_fingerprint(x)
+    y = x.copy()
+    y[17] = np.frombuffer(
+        (np.frombuffer(y[17].tobytes(), np.uint32) ^ np.uint32(1 << 12)
+         ).tobytes(), np.float32)[0]
+    assert integrity.host_leaf_fingerprint(y) != fp
+    # position-weighted: permuted content must NOT collide
+    perm = x[::-1].copy()
+    assert integrity.host_leaf_fingerprint(perm) != fp
+    # -0.0 and 0.0 are different BITS
+    assert integrity.host_leaf_fingerprint(np.float32([0.0])) != \
+        integrity.host_leaf_fingerprint(np.float32([-0.0]))
+
+
+def test_device_host_fingerprint_parity():
+    rng = np.random.RandomState(3)
+    for arr in (rng.randn(33).astype("f"), rng.randn(4, 5).astype("f"),
+                np.float32(2.5), rng.randn(7).astype(np.float16),
+                np.arange(9, dtype=np.int32)):
+        dev = int(np.asarray(jax.jit(integrity.leaf_fingerprint)(
+            jax.numpy.asarray(arr))))
+        assert dev == integrity.host_leaf_fingerprint(arr), arr.dtype
+
+
+def test_fingerprint_determinism_two_runs():
+    """Two identical runs produce identical manifest records — the
+    property every downstream verify rests on."""
+    recs = []
+    for _ in range(2):
+        t = _trainer(integrity="fp", integrity_period=2)
+        for x, y in _batches(4):
+            _feed(t, x, y)
+        recs.append(t.state_fingerprint())
+    assert recs[0] == recs[1]
+    assert recs[0]["algo"] == integrity.ALGO
+    # the record covers params, aux, AND optimizer state
+    assert any(p.startswith("arg:") for p in recs[0]["leaves"])
+    assert any(p.startswith("opt:") for p in recs[0]["leaves"])
+
+
+def test_fp_mode_is_bit_identical_to_off():
+    toff = _trainer()
+    tfp = _trainer(integrity="fp", integrity_period=2)
+    for x, y in _batches(5):
+        _feed(toff, x, y)
+        _feed(tfp, x, y)
+    for n, v in toff.get_params()[0].items():
+        assert np.array_equal(v.asnumpy(),
+                              tfp.get_params()[0][n].asnumpy()), n
+
+
+# ======================================================================
+# vote: detection + blame
+def test_bitflip_vote_detects_and_blame_resolves_via_replay():
+    """2-replica mesh: a 1-vs-1 split carries no internal majority —
+    detection raises with blame indeterminate, and the rollback replay
+    (honest re-execution reaching the same update) exonerates the
+    matching replica and blames the other."""
+    mesh = _mesh(2)
+    t = _trainer(integrity="vote", integrity_period=4, mesh=mesh)
+    assert t._integ_mode == "vote"
+    faults.configure("bitflip@step=7:rank=1:leaf=fc1_weight")
+    blamed = []
+    t.on_integrity_blame = blamed.append
+    batches = _batches(10)
+    with pytest.raises(IntegrityError) as err:
+        for x, y in batches:
+            _feed(t, x, y)
+    rec = err.value.record
+    assert rec["step"] == 8 and rec["mode"] == "vote"
+    assert rec["leaves"] == ["arg:fc1_weight"]
+    assert rec["blamed"] is None            # no strict majority of 2
+    assert t.integrity_divergences == 1
+
+    # roll back to step 0 (fresh state + fresh opt blob) and replay
+    fresh = _trainer(integrity="vote", integrity_period=4, mesh=mesh)
+    t.set_params({k: mx.nd.array(v) for k, v in _fixed_params().items()},
+                 {})
+    t.set_opt_states(fresh.get_opt_states())
+    for x, y in batches:
+        _feed(t, x, y)
+    assert blamed and blamed[0]["blamed"] == [1]
+
+    # bit-identical to an uninjected run after rollback + re-step
+    clean = _trainer(integrity="vote", integrity_period=4, mesh=mesh)
+    for x, y in batches:
+        _feed(clean, x, y)
+    for n, v in clean.get_params()[0].items():
+        assert np.array_equal(v.asnumpy(), t.get_params()[0][n].asnumpy())
+
+
+def test_two_replica_blame_indeterminate_when_not_adjacent():
+    """A flip that survives intermediate steps cross-pollinates the
+    honest replica through the psum'd gradients: the replay then
+    matches NO recorded row and blame stays indeterminate — detection
+    and recovery are unaffected (documented scope of 2-replica
+    attribution; >=3 replicas majority-blame with no adjacency
+    requirement)."""
+    mesh = _mesh(2)
+    t = _trainer(integrity="vote", integrity_period=4, mesh=mesh)
+    faults.configure("bitflip@step=5:rank=1:leaf=fc1_weight")
+    blamed = []
+    t.on_integrity_blame = blamed.append
+    batches = _batches(10)
+    with pytest.raises(IntegrityError) as err:
+        for x, y in batches:
+            _feed(t, x, y)                       # flip@5, detect@8
+    assert err.value.record["step"] == 8
+    assert err.value.record["blamed"] is None
+    fresh = _trainer(integrity="vote", integrity_period=4, mesh=mesh)
+    t.set_params({k: mx.nd.array(v) for k, v in _fixed_params().items()},
+                 {})
+    t.set_opt_states(fresh.get_opt_states())
+    for x, y in batches:
+        _feed(t, x, y)
+    assert blamed == [] and t._integrity_pending is None
+    # recovery still bit-identical to an uninjected run
+    clean = _trainer(integrity="vote", integrity_period=4, mesh=mesh)
+    for x, y in batches:
+        _feed(clean, x, y)
+    for n, v in clean.get_params()[0].items():
+        assert np.array_equal(v.asnumpy(), t.get_params()[0][n].asnumpy())
+
+
+def test_bitflip_vote_majority_blames_at_detection():
+    """4 replicas: 3-vs-1 is a strict majority — the outvoted rank is
+    blamed in the raising record, no replay needed."""
+    if len(jax.devices()) < 4:
+        pytest.skip("needs 4 virtual devices")
+    t = _trainer(batch=8, integrity="vote", integrity_period=2,
+                 mesh=_mesh(4))
+    faults.configure("bitflip@step=3:rank=2:leaf=fc2_weight:bit=3")
+    with pytest.raises(IntegrityError) as err:
+        for x, y in _batches(6):
+            _feed(t, x, y)
+    rec = err.value.record
+    assert rec["mode"] == "vote" and rec["world"] == 4
+    assert rec["blamed"] == [2]
+    assert rec["leaves"] == ["arg:fc2_weight"]
+    assert t.integrity_blamed and t.integrity_blamed[0]["blamed"] == [2]
+
+
+def test_audit_fallback_single_device():
+    """One device has nobody to vote with: the fallback re-executes the
+    step from saved inputs and compares fingerprints (XLA programs are
+    deterministic — ANY difference is corruption)."""
+    t = _trainer(integrity="audit", integrity_period=3)
+    assert t._integ_mode == "audit"
+    faults.configure("bitflip@step=3:rank=0:leaf=fc2_weight:bit=5")
+    with pytest.raises(IntegrityError) as err:
+        for x, y in _batches(6):
+            _feed(t, x, y)
+    assert err.value.record["mode"] == "audit"
+    # and a clean run never false-positives
+    t2 = _trainer(integrity="audit", integrity_period=2)
+    for x, y in _batches(6):
+        _feed(t2, x, y)
+    assert t2.integrity_divergences == 0
+
+
+def test_vote_falls_back_to_audit_without_data_mesh():
+    t = _trainer(integrity="vote", integrity_period=2)
+    assert t._integ_mode == "audit"
+
+
+# ======================================================================
+# ZeRO-1: sharded state fingerprints
+def test_zero1_shard_checksums_and_layout_invariance():
+    """Under ZeRO-1 the optimizer shards legitimately differ per
+    replica: they are fingerprinted per-shard (recorded) but sit out
+    the vote — a clean run never false-positives — and the fingerprint
+    is LAYOUT-invariant: the device computation over the sharded leaves
+    equals the numpy re-hash of their gathered copies bit for bit
+    (position-weighted commutative math), which is exactly what lets
+    ``latest_verified`` re-hash a checkpoint saved from a sharded run."""
+    mesh = _mesh(2)
+    tz = _trainer(integrity="vote", integrity_period=3, mesh=mesh, zero=1)
+    for x, y in _batches(6):
+        _feed(tz, x, y)
+    assert tz.integrity_divergences == 0
+    # momentum leaves are zero-sharded: they must NOT be vote columns
+    rep = {p: m for p, m in zip(tz._integ_paths, tz._integ_rep_mask)}
+    assert all(m for p, m in rep.items() if p.startswith("arg:"))
+    assert not all(m for p, m in rep.items() if p.startswith("opt:"))
+    # device fingerprint over SHARDED leaves == numpy over gathered
+    rz = tz.state_fingerprint()
+    named = [(p, np.asarray(tz._host_value(v)))
+             for p, v in tz._named_state()]
+    host_global, host_leaves = integrity.host_fingerprint(named)
+    assert rz["global"] == host_global
+    assert rz["leaves"] == host_leaves
+
+
+def test_zero1_bitflip_on_replicated_leaf_detected():
+    mesh = _mesh(2)
+    t = _trainer(integrity="vote", integrity_period=4, mesh=mesh, zero=1)
+    faults.configure("bitflip@step=7:rank=0:leaf=fc1_weight")
+    with pytest.raises(IntegrityError) as err:
+        for x, y in _batches(10):
+            _feed(t, x, y)
+    assert err.value.record["leaves"] == ["arg:fc1_weight"]
+
+
+# ======================================================================
+# faults DSL satellites
+def test_unknown_fault_key_is_a_parse_error():
+    with pytest.raises(MXNetError) as err:
+        faults.configure("nan_grad@setp=3")
+    msg = str(err.value)
+    assert "setp" in msg and "step" in msg       # named + suggested
+    with pytest.raises(MXNetError):
+        faults.configure("bitflip@step=1:lead=fc1*")
+
+
+def test_bitflip_payload_keys_carried_not_matched():
+    faults.configure("bitflip@step=2:rank=0:leaf=fc?_weight:bit=5")
+    assert faults.hit_params("bitflip", step=1, rank=0) is None
+    got = faults.hit_params("bitflip", step=2, rank=0)
+    assert got == {"leaf": "fc?_weight", "bit": 5}
+    assert faults.hit_params("bitflip", step=3, rank=0) is None  # spent
+
+
+def test_match_leaf_namespace_alias_and_literal_brackets():
+    """Only * and ? are wildcards — the [0] in a tuple-state opt path
+    is literal, not an fnmatch character class — and '/' spells the
+    namespace colon the fault grammar reserves for conditions."""
+    paths = ["arg:fc1_weight", "opt:fc1_weight[0]", "opt:fc1_weight[1]"]
+    assert integrity.match_leaf("opt/fc1_weight[0]", paths) \
+        == "opt:fc1_weight[0]"
+    assert integrity.match_leaf("fc1_weight[1]", paths) \
+        == "opt:fc1_weight[1]"
+    assert integrity.match_leaf("arg/fc1_weight", paths) \
+        == "arg:fc1_weight"
+    assert integrity.match_leaf("opt/fc1_weight[?]", paths) \
+        == "opt:fc1_weight[0]"
+    assert integrity.match_leaf("fc1_weight[2]", paths) is None
+
+
+def test_namespaced_leaf_colon_is_a_parse_error():
+    """leaf=arg:fc1_weight cannot be expressed — ':' splits conditions,
+    leaving a bogus site word that must be a loud error (with the
+    '/'-spelling fix named), not a directive that never fires."""
+    with pytest.raises(MXNetError) as err:
+        faults.configure("bitflip@step=1:rank=0:leaf=arg:fc1_weight")
+    msg = str(err.value)
+    assert "fc1_weight" in msg and "leaf=arg/fc1_weight" in msg
+
+
+def test_bitflip_targets_opt_leaf_via_namespace_alias():
+    """leaf=opt/NAME selects the optimizer-state leaf over its
+    same-named arg sibling (the bare glob prefers args: sorted order)."""
+    mesh = _mesh(2)
+    t = _trainer(integrity="vote", integrity_period=2, mesh=mesh)
+    faults.configure("bitflip@step=1:rank=1:leaf=opt/fc1_weight")
+    with pytest.raises(IntegrityError) as err:
+        for x, y in _batches(4):
+            _feed(t, x, y)
+    assert "opt:fc1_weight" in err.value.record["leaves"]
+
+
+def test_bitflip_unmatched_leaf_glob_is_loud():
+    t = _trainer(integrity="fp", integrity_period=100)
+    faults.configure("bitflip@step=1:rank=0:leaf=nosuch*")
+    with pytest.raises(MXNetError) as err:
+        for x, y in _batches(1):
+            _feed(t, x, y)
+    assert "nosuch*" in str(err.value)
+
+
+# ======================================================================
+# manifest fingerprint verification
+def _fit_module(train, num_epoch, prefix=None, resume=False, ctx=None,
+                elastic_coord=None):
+    mx.random.seed(0)
+    old = os.environ.get("MXTPU_MODULE_FUSED")
+    os.environ["MXTPU_MODULE_FUSED"] = "always"
+    try:
+        mod = mx.mod.Module(_mlp_symbol(), context=ctx or mx.cpu())
+        mod.fit(train, num_epoch=num_epoch,
+                optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                                  "rescale_grad": 1.0 / 8},
+                initializer=mx.init.Xavier(), checkpoint=prefix,
+                resume=resume, elastic=elastic_coord)
+    finally:
+        if old is None:
+            os.environ.pop("MXTPU_MODULE_FUSED", None)
+        else:
+            os.environ["MXTPU_MODULE_FUSED"] = old
+    return mod
+
+
+def _train_iter(n=40, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 32).astype("f")
+    y = rng.randint(0, 4, n).astype("f")
+    return io.NDArrayIter(x, y, batch_size=8, shuffle=False)
+
+
+def _byte_patch_with_valid_crc(mgr, ck):
+    """Flip a payload byte in the params file and re-hash the manifest
+    CRC — the tamper/corruption CRC-of-bytes cannot see."""
+    with open(ck.params_path, "rb") as f:
+        blob = bytearray(f.read())
+    blob[len(blob) // 2] ^= 0x10
+    with open(ck.params_path, "wb") as f:
+        f.write(bytes(blob))
+    mpath = mgr._manifest_path(ck.epoch)
+    with open(mpath) as f:
+        man = json.load(f)
+    man["files"][os.path.basename(ck.params_path)] = {
+        "crc32": zlib.crc32(bytes(blob)) & 0xFFFFFFFF,
+        "size": len(blob)}
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+
+
+def test_manifest_records_device_fingerprint(tmp_path):
+    prefix = str(tmp_path / "ck")
+    _fit_module(_train_iter(), num_epoch=2, prefix=prefix)
+    mgr = resilience.CheckpointManager(prefix)
+    ck = mgr.latest()
+    rec = ck.manifest["integrity"]
+    assert rec["algo"] == integrity.ALGO
+    assert any(p.startswith("opt:") for p in rec["leaves"])
+    assert mgr.verify_fingerprint(ck)
+    assert mgr.latest_verified().epoch == ck.epoch
+
+
+def test_manifest_verify_rejects_byte_patch_with_valid_crc(tmp_path):
+    prefix = str(tmp_path / "ck")
+    _fit_module(_train_iter(), num_epoch=3, prefix=prefix)
+    mgr = resilience.CheckpointManager(prefix)
+    ck = mgr.latest()
+    assert ck.epoch == 3
+    _byte_patch_with_valid_crc(mgr, ck)
+    # the CRC tier is green — the byte patch re-hashed it
+    assert mgr.verify(3) is not None
+    assert mgr.latest().epoch == 3
+    # the fingerprint tier is not: values no longer match what the
+    # device held at save
+    assert not mgr.verify_fingerprint(mgr.verify(3))
+    assert mgr.latest_verified().epoch == 2
+
+
+def test_states_blob_patch_fails_fingerprint(tmp_path):
+    """The opt-state blob is covered too: patch a momentum value inside
+    the pickle and re-hash its CRC — fingerprint verify must reject."""
+    import pickle
+    prefix = str(tmp_path / "ck")
+    _fit_module(_train_iter(), num_epoch=2, prefix=prefix)
+    mgr = resilience.CheckpointManager(prefix)
+    ck = mgr.latest()
+    with open(ck.states_path, "rb") as f:
+        loaded = list(pickle.loads(f.read()))
+    state = loaded[1]
+    name = sorted(state)[0]
+    leaf = jax.tree_util.tree_leaves(state[name])[0]
+    np.asarray(leaf).ravel()[0] += 1.0      # host arrays: in-place
+    with open(ck.states_path, "wb") as f:
+        f.write(pickle.dumps(tuple(loaded)))
+    mpath = mgr._manifest_path(ck.epoch)
+    with open(mpath) as f:
+        man = json.load(f)
+    crc, size = resilience._crc32_file(ck.states_path)
+    man["files"][os.path.basename(ck.states_path)] = {"crc32": crc,
+                                                      "size": size}
+    with open(mpath, "w") as f:
+        json.dump(man, f)
+    assert mgr.verify(ck.epoch) is not None
+    assert not mgr.verify_fingerprint(mgr.verify(ck.epoch))
+    assert mgr.latest_verified().epoch == ck.epoch - 1
+
+
+def test_save_refuses_fingerprint_on_divergent_state(tmp_path,
+                                                     monkeypatch):
+    """A corruption landing between the last periodic check and an
+    epoch-end save must not be stamped into a 'verified' checkpoint:
+    ``state_fingerprint`` votes on the CURRENT state and refuses, the
+    save stays CRC-only with an explicit refusal record (a missing
+    record verifies vacuously — legacy saves), and ``latest_verified``
+    skips it."""
+    monkeypatch.setenv("MXTPU_INTEGRITY_MODE", "vote")
+    monkeypatch.setenv("MXTPU_INTEGRITY_PERIOD", "1000")  # never in-step
+    mod = _fit_module(_train_iter(), num_epoch=1, prefix=None,
+                      ctx=_mesh(2))
+    mgr = resilience.CheckpointManager(str(tmp_path / "ck"))
+    mgr.save(mod, 1)                         # clean state: verified
+    assert mgr.latest_verified().epoch == 1
+    tr = mod._trainer
+    path = "arg:fc1_weight"
+    named = dict(tr._named_state())
+    tr._set_state_leaf(path, integrity.bitflip(
+        named[path], 1, bit=12, mesh=tr.mesh,
+        spec=tr._state_leaf_spec(path)))
+    with pytest.raises(IntegrityError):
+        mod.state_fingerprint()
+    mgr.save(mod, 2)                         # divergent: refused record
+    assert mgr.latest().epoch == 2           # CRC tier still passes
+    assert (mgr.verify(2).manifest["integrity"] or {}).get("refused")
+    assert not mgr.verify_fingerprint(mgr.verify(2))
+    assert mgr.latest_verified().epoch == 1  # never a rollback target
+
+
+# ======================================================================
+# retention: the newest VERIFIED checkpoint survives rotation
+def test_retention_never_deletes_newest_verified(tmp_path):
+    """N newer-but-corrupt saves must not rotate out the last state
+    anyone can roll back to (regression for the keep-N carve-out)."""
+    prefix = str(tmp_path / "keep")
+    mod = _fit_module(_train_iter(), num_epoch=1, prefix=None)
+    mgr = resilience.CheckpointManager(prefix, keep=10)
+    mgr.save(mod, 1)                         # the good save
+    # a corrupt DEVICE stamps fingerprints that do not match the bytes
+    # it hands the host — simulate by lying in state_fingerprint
+    real = mod.state_fingerprint
+
+    def corrupt_fingerprint():
+        rec = real()
+        rec["global"] = (rec["global"] + 1) & 0xFFFFFFFF
+        return rec
+
+    mod.state_fingerprint = corrupt_fingerprint
+    for epoch in (2, 3, 4):
+        mgr.save(mod, epoch)
+    mod.state_fingerprint = real
+    mgr.keep = 2
+    mgr._prune()
+    names = sorted(os.listdir(tmp_path))
+    # keep-2 window is {3, 4}; epoch 1 survives as the newest verified
+    assert any("-0001.params" in n for n in names), names
+    assert not any("-0002." in n for n in names), names
+    assert any("-0004.params" in n for n in names), names
+    assert mgr.latest().epoch == 4           # CRC tier: corrupt wins
+    assert mgr.latest_verified().epoch == 1  # fingerprint tier: floor
+
+
+# ======================================================================
+# the full recovery protocol through Module.fit
+def _fit_env(monkeypatch, period="4"):
+    monkeypatch.setenv("MXTPU_INTEGRITY_MODE", "vote")
+    monkeypatch.setenv("MXTPU_INTEGRITY_PERIOD", period)
+
+
+def test_fit_detect_rollback_restep_bit_identical(tmp_path, monkeypatch):
+    """The acceptance e2e: bitflip@step=7:rank=1 on a 2-replica mesh —
+    detected at the next period (step 8), blamed on rank 1 by the
+    replay, rolled back to the epoch-1 checkpoint, and the final params
+    are bit-identical to an uninjected run."""
+    _fit_env(monkeypatch)
+    clean = _fit_module(_train_iter(), num_epoch=3,
+                        prefix=str(tmp_path / "clean"), ctx=_mesh(2))
+    faults.configure("bitflip@step=7:rank=1:leaf=fc1_weight")
+    injected = _fit_module(_train_iter(), num_epoch=3,
+                           prefix=str(tmp_path / "inj"), ctx=_mesh(2))
+    tr = injected._trainer
+    assert tr.integrity_divergences == 1
+    assert tr.integrity_blamed and tr.integrity_blamed[0]["blamed"] == [1]
+    pa, _ = clean.get_params()
+    pb, _ = injected.get_params()
+    for n in pa:
+        assert np.array_equal(pa[n].asnumpy(), pb[n].asnumpy()), n
+
+
+def test_fit_divergence_cap_aborts(tmp_path, monkeypatch):
+    """A persistently corrupt replica re-diverges after every rollback:
+    the consecutive-divergence cap must raise MXNetError instead of
+    rollback-looping forever."""
+    _fit_env(monkeypatch)
+    monkeypatch.setenv("MXTPU_INTEGRITY_MAX_ROLLBACKS", "2")
+    # threshold semantics: step>=6 fires every update, count bounds it
+    faults.configure("bitflip@step=6:rank=1:leaf=fc1_weight:count=99")
+    with pytest.raises(MXNetError) as err:
+        _fit_module(_train_iter(), num_epoch=3,
+                    prefix=str(tmp_path / "cap"), ctx=_mesh(2))
+    assert "consecutive divergences" in str(err.value)
+
+
+def test_fit_divergence_without_checkpoint_is_loud(monkeypatch):
+    _fit_env(monkeypatch)
+    faults.configure("bitflip@step=3:rank=1:leaf=fc1_weight")
+    with pytest.raises(MXNetError) as err:
+        _fit_module(_train_iter(), num_epoch=2, prefix=None,
+                    ctx=_mesh(2))
+    assert "no checkpoint line" in str(err.value)
+
+
+# ======================================================================
+# quarantine: blame feeds the elastic membership-shrink path
+def test_quarantine_publishes_membership_without_rank():
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        c0 = elastic.ElasticCoordinator(rank=0, num_workers=2,
+                                        directory=d, hb_timeout=30,
+                                        join_grace=30, check_interval=0.0)
+        try:
+            mem = c0.quarantine(1)
+            assert mem.world == [0] and mem.dead == [1]
+            # idempotent: an already-absent rank publishes nothing
+            again = c0.quarantine(1)
+            assert again.epoch == mem.epoch
+            # refusing to quarantine the last member
+            with pytest.raises(MXNetError):
+                c0.quarantine(0)
+        finally:
+            c0.close()
+
+
+def test_quarantine_folds_lapsed_peers_into_publish():
+    """Same-epoch publishes clobber each other (atomic rename, last
+    write wins), and the monitor's dead-host shrink carries different
+    content than a quarantine.  The quarantine record must therefore
+    remove concurrently-lapsed peers too: whichever writer lands last,
+    a dead rank is never resurrected into the membership."""
+    import tempfile
+    import time as _time
+    from mxnet_tpu import health
+    with tempfile.TemporaryDirectory() as d:
+        c0 = elastic.ElasticCoordinator(rank=0, num_workers=3,
+                                        directory=d, hb_timeout=0.3,
+                                        join_grace=0.0,
+                                        check_interval=0.0)
+        try:
+            # rank 2 stamps once and goes stale: lapsed by hb_timeout
+            h2 = health.Heartbeat(2, directory=d, interval=999)
+            h2.stop()
+            _time.sleep(0.4)
+            # rank 1 (the outvoted replica) is alive and heartbeating
+            h1 = health.Heartbeat(1, directory=d, interval=999)
+            h1.stop()
+            mem = c0.quarantine(1)
+            assert mem.world == [0]
+            assert mem.dead == [1, 2]
+        finally:
+            c0.close()
+
+
+def test_fit_blame_quarantines_outvoted_rank(tmp_path, monkeypatch):
+    """With an elastic coordinator attached, a resolved blame shrinks
+    the blamed replica out of the membership by POLICY — the flaky chip
+    is alive and heartbeating; that is the point."""
+    _fit_env(monkeypatch)
+
+    class _StubElastic:
+        def __init__(self):
+            self.quarantined = []
+
+        def guard(self, step=None):
+            return None
+
+        def quarantine(self, rank):
+            self.quarantined.append(int(rank))
+
+    coord = _StubElastic()
+    faults.configure("bitflip@step=7:rank=1:leaf=fc1_weight")
+    _fit_module(_train_iter(), num_epoch=3,
+                prefix=str(tmp_path / "q"), ctx=_mesh(2),
+                elastic_coord=coord)
+    assert coord.quarantined == [1]
