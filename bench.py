@@ -319,6 +319,32 @@ def _stream_pipeline(mx, mod, metric, staged_img_s, steps=None,
     return out
 
 
+class CommModelDrift(RuntimeError):
+    """The static comm-plan prediction left the 5% band around the
+    analytic gradient-wire model — a GATE failure, distinct from a mere
+    trace failure (which reads as ``comm_model_error``)."""
+
+
+def _assert_comm_model(line, trainer):
+    """Fill ``comm_model_gb_per_step`` from the static comm plan and
+    assert <= 5% disagreement with the analytic
+    ``grad_comm_gb_per_step`` (``line`` may be a bench line or a
+    ``zero_ab`` row — both carry the analytic field)."""
+    from mxnet_tpu.analysis import comm_passes
+    plan = trainer.comm_plan()
+    model_gb = comm_passes.plan_wire_gb(plan)
+    line["comm_model_gb_per_step"] = round(model_gb, 6)
+    analytic_gb = trainer.grad_comm_bytes_per_step() / 1e9
+    if abs(model_gb - analytic_gb) > 0.05 * max(analytic_gb, 1e-9):
+        raise CommModelDrift(
+            "static comm model disagrees with the analytic gradient-"
+            "wire model: comm_model_gb_per_step=%.6f vs "
+            "grad_comm_gb_per_step=%.6f (>5%%) — the comm-plan byte "
+            "predictor (analysis/comm_passes.py) and "
+            "collectives.lowp_comm_bytes have drifted"
+            % (model_gb, analytic_gb))
+
+
 def _zero_ab(mx, n_steps=4):
     """ZeRO-1 / grad-dtype A/B on a small MLP over ALL local devices
     (docs/how_to/perf.md "Optimizer sharding"): per-chip optimizer-state
@@ -371,6 +397,17 @@ def _zero_ab(mx, n_steps=4):
                "opt_state_bytes_per_chip": t.opt_state_bytes_per_chip(),
                "grad_comm_gb_per_step": round(
                    t.grad_comm_bytes_per_step() / 1e9, 6)}
+        # the static comm plan must agree with the analytic wire model
+        # on every corner — this is the 4-corner check the CPU gate can
+        # actually run with a real >=2-way mesh.  Only DRIFT escapes
+        # (the gate); a trace hiccup is recorded on the row so the
+        # other corners and the bit-identity fields still land
+        try:
+            _assert_comm_model(row, t)
+        except CommModelDrift:
+            raise
+        except Exception as e:                      # noqa: BLE001
+            row["comm_model_error"] = str(e)
         if base is None:
             base = params
         else:
@@ -757,9 +794,30 @@ def main():
         mod._trainer.opt_state_bytes_per_chip()
     line["grad_comm_gb_per_step"] = round(
         mod._trainer.grad_comm_bytes_per_step() / 1e9, 6)
+    # static comm-plan prediction beside the analytic figure
+    # (docs/how_to/static_analysis.md "Communication analysis"): the
+    # jaxpr-extracted + SPMD-synthesized plan's wire bytes MUST agree
+    # with grad_comm_gb_per_step within 5% — a drifting static model
+    # would silently mis-gate COMM_BASELINE.json and mis-feed the
+    # autotuner's cheap surrogate.  Asserted, not just reported (the
+    # MULTICHIP_PARITY pattern); own except so a trace failure reads as
+    # comm_model_error, never a fake agreement — and never a fake gate:
+    # only the dedicated drift type re-raises (MXNetError and jax's
+    # XlaRuntimeError both subclass RuntimeError, so a bare
+    # RuntimeError re-raise would abort the bench on a trace hiccup).
+    try:
+        _assert_comm_model(line, mod._trainer)
+    except CommModelDrift:
+        raise
+    except Exception as e:                          # noqa: BLE001
+        line["comm_model_error"] = str(e)
     if os.environ.get("MXTPU_BENCH_ZERO_AB", "1") != "0":
         try:
             line["zero_ab"] = _zero_ab(mx)
+        except CommModelDrift:
+            # the 4-corner drift assertion inside _zero_ab is a GATE —
+            # it must not be swallowed into zero_ab_error
+            raise
         except Exception as e:                      # noqa: BLE001
             line["zero_ab_error"] = str(e)
 

@@ -132,6 +132,17 @@ stage "graph lint gate (trace-time, no device execution)"
 # prints the finding summary — docs/how_to/graph_lint.md
 python tools/graph_lint.py --check
 
+stage "comm lint gate (static collective-communication analysis)"
+# extracts the comm plan (collective, axis, dtype, predicted wire
+# bytes, layer provenance) of the fused ZeRO-1+bf16 trainer step, the
+# serving forward, and the shard_map'd ring-attention/pipeline
+# programs, runs the comm rules (f32-wire, resharding-thrash,
+# comm-budget, rank-divergent-collective), and FAILS on NEW error
+# findings or a predicted-GB regression vs the checked-in
+# COMM_BASELINE.json (ratchet with --write-baseline) — pure trace
+# time, docs/how_to/static_analysis.md "Communication analysis"
+python tools/comm_lint.py --check
+
 stage "concurrency sanitizer gate (static lint + MXTPU_TSAN=1 lockset sweep)"
 # half 1: the AST thread-safety rules over mxnet_tpu/ (no imports, no
 # devices) gated on RACE_BASELINE.json — unnamed threads, undeclared

@@ -26,8 +26,12 @@ from . import symbol_passes  # noqa: F401  registers the symbol passes
 from . import jaxpr_passes   # noqa: F401  registers the jaxpr passes
 from . import concurrency   # noqa: F401  registers source/runtime passes
 from .concurrency import lint_events, lint_runtime, lint_source, replay_log
+from . import comm_passes   # noqa: F401  registers the comm passes
+from .comm_passes import (CommEntry, extract_comm_plan, lint_comm,
+                          lint_comm_source, plan_digest, plan_wire_gb,
+                          scan_rank_divergence)
 from .baseline import (BASELINE_PATH, baseline_entry, check_baseline,
-                       load_baseline, write_baseline)
+                       load_baseline, run_gate, write_baseline)
 
 __all__ = [
     "ERROR", "WARN", "INFO", "SEVERITIES", "Annotation", "Finding",
@@ -37,6 +41,9 @@ __all__ = [
     "lint_trainer",
     "lint_server", "lint_source", "lint_runtime", "lint_events",
     "replay_log",
+    "CommEntry", "extract_comm_plan", "lint_comm", "lint_comm_source",
+    "plan_digest", "plan_wire_gb", "scan_rank_divergence",
     "BASELINE_PATH", "baseline_entry", "check_baseline", "load_baseline",
-    "write_baseline", "symbol_passes", "jaxpr_passes", "concurrency",
+    "run_gate", "write_baseline", "symbol_passes", "jaxpr_passes",
+    "concurrency", "comm_passes",
 ]

@@ -16,7 +16,7 @@ from typing import Dict, Tuple
 from .core import LintReport
 
 __all__ = ["BASELINE_PATH", "baseline_entry", "load_baseline",
-           "check_baseline", "write_baseline"]
+           "check_baseline", "write_baseline", "run_gate"]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -77,12 +77,41 @@ def check_baseline(reports: Dict[str, LintReport],
     return ok, msgs
 
 
-def write_baseline(reports: Dict[str, LintReport], path=None) -> str:
+def write_baseline(reports: Dict[str, LintReport], path=None,
+                   extras: Dict[str, Dict] = None) -> str:
+    """Record ``reports`` into the baseline file.  ``extras`` merges
+    additional per-model fields into each entry (the comm linter
+    records ``comm_gb_per_step`` beside the finding counts, the
+    STEP_BYTE_BUDGET pattern)."""
     path = path or BASELINE_PATH
     baseline = load_baseline(path) or {}
     for model, report in reports.items():
-        baseline[model] = baseline_entry(report)
+        entry = baseline_entry(report)
+        if extras and model in extras:
+            entry.update(extras[model])
+        baseline[model] = entry
     with open(path, "w") as f:
         json.dump(baseline, f, indent=1, sort_keys=True)
         f.write("\n")
     return path
+
+
+def run_gate(reports: Dict[str, LintReport], label: str,
+             check: bool = False, write: bool = False, path=None,
+             extras: Dict[str, Dict] = None) -> int:
+    """The CLIs' shared ratchet block (``tools/graph_lint.py``,
+    ``tools/concurrency_lint.py``, ``tools/comm_lint.py``): on
+    ``write``, record the baseline and say where; on ``check``, gate
+    NEW error findings against it and print the verdict.  Returns the
+    process exit code."""
+    if write:
+        out = write_baseline(reports, path=path, extras=extras)
+        print("%s: baseline written -> %s" % (label, out))
+        return 0
+    if check:
+        ok, msgs = check_baseline(reports, path=path)
+        for m in msgs:
+            print("%s: %s" % (label, m))
+        print("%s: baseline gate %s" % (label, "OK" if ok else "FAILED"))
+        return 0 if ok else 1
+    return 0

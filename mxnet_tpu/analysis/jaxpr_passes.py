@@ -19,14 +19,15 @@ import numpy as np
 from .core import (ERROR, INFO, WARN, Finding, GraphPass, PassContext,
                    register_pass)
 
-__all__ = ["iter_eqns", "layer_of_eqn", "F64WideningPass",
+__all__ = ["iter_eqns", "iter_eqns_scoped", "layer_of_eqn",
+           "F64WideningPass",
            "HostCallbackPass", "DonationPass", "GatherScatterPass",
            "ReplicatedOptStatePass", "ServeShapeBucketPass"]
 
 _SCOPE_RE = re.compile(r"^(transpose\()?(?:jvp\()?([A-Za-z0-9_.\-]+?)\)*$")
 
 
-def layer_of_eqn(eqn) -> Tuple[Optional[str], bool]:
+def layer_of_eqn(eqn, prefix: str = "") -> Tuple[Optional[str], bool]:
     """``(symbol_layer, is_backward)`` from an equation's name stack.
 
     The executor's per-node ``jax.named_scope`` leaves the symbol node
@@ -34,11 +35,19 @@ def layer_of_eqn(eqn) -> Tuple[Optional[str], bool]:
     ``jvp(conv0)`` forward, ``transpose(jvp(conv0))`` backward.  Deepest
     symbol scope wins (mirrors ``step_breakdown.layer_from_op_name``,
     which parses the same stack out of XLA instruction metadata).
+
+    ``prefix`` is the accumulated name stack of the ENCLOSING call
+    equations (:func:`iter_eqns_scoped`): an equation inside a
+    ``shard_map``/``pjit``/``scan`` body only carries the stack relative
+    to that body, so a scope applied AROUND the call — the common case
+    for the trainer's shard_map'd backward — would otherwise be lost.
     """
     try:
         stack = str(eqn.source_info.name_stack)
     except Exception:  # pragma: no cover - older jax layouts
-        return None, False
+        stack = ""
+    if prefix:
+        stack = "%s/%s" % (prefix, stack) if stack else prefix
     layer, bwd = None, False
     for part in stack.split("/"):
         if "(" in part and not part.startswith(("transpose(", "jvp(")):
@@ -73,19 +82,63 @@ def _sub_jaxprs(eqn):
                     yield w.jaxpr
 
 
-def iter_eqns(jaxpr) -> Iterator:
-    """Every equation of a (Closed)Jaxpr, recursing through nested
-    call/pjit/custom-vjp/scan bodies."""
+def _eqn_stack(eqn) -> str:
+    try:
+        return str(eqn.source_info.name_stack)
+    except Exception:  # pragma: no cover - older jax layouts
+        return ""
+
+
+def _trip_count(eqn) -> int:
+    """Static per-call execution count of ``eqn``'s sub-jaxprs: a
+    ``scan`` body runs ``length`` times (``fori_loop`` with static
+    bounds lowers to scan); everything else — pjit, shard_map, cond
+    branches, while bodies (trip count unknowable) — counts once."""
+    if eqn.primitive.name == "scan":
+        try:
+            return max(1, int(eqn.params.get("length", 1)))
+        except (TypeError, ValueError):
+            return 1
+    return 1
+
+
+def iter_eqns_scoped(jaxpr, prefix: str = "",
+                     repeat: int = 1) -> Iterator:
+    """``(eqn, prefix, repeat)`` for every equation of a (Closed)Jaxpr,
+    recursing through nested call/pjit/shard_map/custom-vjp/scan
+    bodies.  ``prefix`` accumulates the name stacks of the ENCLOSING
+    call equations so :func:`layer_of_eqn` can attribute an equation
+    inside a sub-jaxpr to a scope applied around the call (a sub-jaxpr
+    equation's own stack is relative to its body — without the prefix,
+    everything inside a ``shard_map`` traced under a ``named_scope``
+    reported ``(unattributed)``).  ``repeat`` is the static execution
+    multiplier (scan trip counts fold in), which the comm byte model
+    needs for collectives living inside a scan body."""
     jx = getattr(jaxpr, "jaxpr", jaxpr)
     for eqn in jx.eqns:
+        yield eqn, prefix, repeat
+        subs = list(_sub_jaxprs(eqn))
+        if not subs:
+            continue
+        stack = _eqn_stack(eqn)
+        sub_prefix = ("%s/%s" % (prefix, stack) if prefix and stack
+                      else (stack or prefix))
+        sub_repeat = repeat * _trip_count(eqn)
+        for sub in subs:
+            for item in iter_eqns_scoped(sub, sub_prefix, sub_repeat):
+                yield item
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """Every equation of a (Closed)Jaxpr, recursing through nested
+    call/pjit/custom-vjp/scan bodies (no scope threading — use
+    :func:`iter_eqns_scoped` when provenance matters)."""
+    for eqn, _, _ in iter_eqns_scoped(jaxpr):
         yield eqn
-        for sub in _sub_jaxprs(eqn):
-            for e in iter_eqns(sub):
-                yield e
 
 
-def _where(eqn):
-    layer, bwd = layer_of_eqn(eqn)
+def _where(eqn, prefix: str = ""):
+    layer, bwd = layer_of_eqn(eqn, prefix)
     if layer is None:
         return None, "(unattributed)"
     return layer, layer + (" (bwd)" if bwd else "")
@@ -110,7 +163,7 @@ class F64WideningPass(GraphPass):
             return []
         out, seen = [], set()
         f64 = np.dtype(np.float64)
-        for eqn in iter_eqns(ctx.jaxpr):
+        for eqn, prefix, _ in iter_eqns_scoped(ctx.jaxpr):
             hit = None
             if eqn.primitive.name == "convert_element_type" \
                     and _is_f64(eqn.params.get("new_dtype", np.float32)):
@@ -125,7 +178,7 @@ class F64WideningPass(GraphPass):
                     % eqn.primitive.name
             if hit is None:
                 continue
-            layer, where = _where(eqn)
+            layer, where = _where(eqn, prefix)
             key = (where, eqn.primitive.name)
             if key in seen:
                 continue
@@ -161,7 +214,7 @@ class HostCallbackPass(GraphPass):
         if ctx.jaxpr is None:
             return []
         out, seen = [], set()
-        for eqn in iter_eqns(ctx.jaxpr):
+        for eqn, prefix, _ in iter_eqns_scoped(ctx.jaxpr):
             pname = eqn.primitive.name
             if pname in _CALLBACK_PRIMS:
                 sev, msg = ERROR, ("host callback %r inside the jitted "
@@ -172,7 +225,7 @@ class HostCallbackPass(GraphPass):
                                   "forces placement mid-program")
             else:
                 continue
-            layer, where = _where(eqn)
+            layer, where = _where(eqn, prefix)
             key = (where, pname)
             if key in seen:
                 continue
@@ -325,14 +378,14 @@ class GatherScatterPass(GraphPass):
         out = []
         sns_layers = []
         counts = {}
-        for eqn in iter_eqns(ctx.jaxpr):
+        for eqn, prefix, _ in iter_eqns_scoped(ctx.jaxpr):
             pname = eqn.primitive.name
             if pname in ("select_and_scatter_add", "select_and_scatter"):
-                _, where = _where(eqn)
+                _, where = _where(eqn, prefix)
                 sns_layers.append(where)
             elif pname in ("gather", "scatter", "scatter-add",
                            "scatter_add"):
-                _, where = _where(eqn)
+                _, where = _where(eqn, prefix)
                 counts[where] = counts.get(where, 0) + 1
         # resolve the EFFECTIVE policy the traced op bodies used: an
         # unset ctx value falls back to the process default

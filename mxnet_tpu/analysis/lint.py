@@ -175,35 +175,20 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
     the lint trace matches the program an int-token or uint8-pipeline
     model actually runs; unlisted inputs trace as float32."""
     import jax
-    import jax.numpy as jnp
 
     if trainer._step_fn is None or trainer.params is None:
         raise MXNetError("lint_trainer needs a bound, initialized Trainer "
                          "(call bind() + init_params() first)")
-    input_dtypes = input_dtypes or {}
-    sds = lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)  # noqa: E731
     sent = getattr(trainer, "_sent", None)
-    args = (
-        {n: sds(v) for n, v in trainer.params.items()},
-        {n: sds(v) for n, v in trainer.aux.items()},
-        jax.tree_util.tree_map(sds, trainer.opt_state),
-    ) + ((jax.tree_util.tree_map(sds, sent),) if sent is not None
-         else ()) + (
-        {n: jax.ShapeDtypeStruct(tuple(s),
-                                 np.dtype(input_dtypes.get(n, np.float32)))
-         for n, s in trainer._input_shapes.items()},
-        jnp.float32(0.01), jnp.int32(1), jax.random.key(0),
-    )
+    args = trainer.abstract_step_args(input_dtypes)
     arg_labels = _STEP_ARG_LABELS if sent is None \
         else _STEP_ARG_LABELS_SENTINEL
     report = LintReport(model="trainer-step")
     try:
-        # same x64 trace as _trace_into: an f64 cast must APPEAR in the
-        # jaxpr instead of being silently truncated (both jaxpr entry
-        # points must give one verdict for one hazard)
-        from jax.experimental import enable_x64
-        with enable_x64():
-            closed = jax.make_jaxpr(trainer._step_fn)(*args)
+        # x64 trace (Trainer.step_jaxpr): an f64 cast must APPEAR in
+        # the jaxpr instead of being silently truncated (both jaxpr
+        # entry points must give one verdict for one hazard)
+        closed = trainer.step_jaxpr(input_dtypes, x64=True)
     except Exception as e:  # noqa: BLE001
         report.extend([Finding("trace-failed", ERROR, "<step>", "<step>",
                                "tracing the fused step failed: %s" % e)])

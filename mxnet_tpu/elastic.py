@@ -60,7 +60,7 @@ from .resilience import retry_io
 
 __all__ = ["ElasticCoordinator", "ElasticShrink", "ElasticRevoked",
            "Membership", "read_membership", "membership_path",
-           "SHRINK_EXIT_CODE"]
+           "comm_plan_path", "SHRINK_EXIT_CODE"]
 
 # a worker that exits because the membership shrank (not because IT
 # failed) uses this code so the launcher can tell "relaunch the
@@ -68,6 +68,12 @@ __all__ = ["ElasticCoordinator", "ElasticShrink", "ElasticRevoked",
 SHRINK_EXIT_CODE = 96
 
 _MEMBERSHIP_FILE = "membership.json"
+
+# sentinel digest a rank publishes when its comm plan could not be
+# traced: peers downgrade parity for that rank to a logged warning
+# instead of dying on a missing stamp (a lint-trace hiccup on one rank
+# must not kill the healthy fleet)
+COMM_PLAN_UNTRACED = "untraced"
 
 # measurement tolerance when deciding whether a heartbeat stamp
 # predates this coordinator's start (previous incarnation) or was
@@ -77,6 +83,13 @@ _INCARNATION_SLACK_S = 1.0
 
 def membership_path(directory: str) -> str:
     return os.path.join(directory, _MEMBERSHIP_FILE)
+
+
+def comm_plan_path(directory: str, rank: int) -> str:
+    """Rank ``rank``'s published comm-plan record (digest + ordered
+    collective keys) — the cross-rank plan parity token
+    (docs/how_to/static_analysis.md "Communication analysis")."""
+    return os.path.join(directory, "commplan-%d" % int(rank))
 
 
 class Membership:
@@ -255,6 +268,15 @@ class ElasticCoordinator:
         self._last_scan = 0.0
         self._guards = 0
         self._mem_cache = None
+        # cross-rank comm-plan parity (docs/how_to/static_analysis.md
+        # "Communication analysis"): armed by publish_comm_plan, checked
+        # once at the first guarded step entry
+        self._comm_digest = None
+        self._comm_keys = None
+        self._comm_checked = False
+        self.comm_parity_timeout = float(
+            os.environ.get("MXTPU_COMM_PARITY_TIMEOUT_S", "")
+            or self.step_timeout)
         # new-incarnation adoption: a record whose world SIZE differs
         # from ours is a previous incarnation's (a supervisor relaunched
         # the shrunk world into the same shared dir with new contiguous
@@ -300,6 +322,119 @@ class ElasticCoordinator:
         except (OSError, ValueError, IndexError):
             return -1
 
+    # ------------------------------------------------- comm-plan parity
+    def publish_comm_plan(self, plan, digest: Optional[str] = None) -> str:
+        """Stamp this rank's comm-plan digest into the shared dir —
+        call BEFORE the first guarded step (``Module.fit`` does, from
+        ``Trainer.comm_plan()``).  ``plan`` is the ordered entry list
+        (``analysis.comm_passes.CommEntry`` or their ``key()``
+        strings); the first :meth:`guard` then refuses to enter the
+        step collectives until every member's digest matches — a
+        rank-divergent program becomes a loud pre-step ``MXNetError``
+        naming the diverging rank and the first differing collective,
+        instead of a silent wedge inside XLA."""
+        keys = [e if isinstance(e, str) else e.key() for e in plan]
+        if digest is None:
+            # the ONE hashing definition — a private copy here could
+            # silently disagree with analysis-computed digests
+            from .analysis.comm_passes import plan_digest
+            digest = plan_digest(keys)
+        record = {"rank": self.rank, "epoch": self._epoch,
+                  "digest": digest, "plan": keys,
+                  "wallclock": time.time()}
+        path = comm_plan_path(self.directory, self.rank)
+        tmp = "%s.tmp.%d" % (path, os.getpid())
+
+        def write():
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        retry_io(write, what="comm plan publish", logger=self.logger)
+        self._comm_digest = digest
+        self._comm_keys = keys
+        self._comm_checked = False
+        return digest
+
+    def _read_comm_plan(self, rank: int) -> Optional[dict]:
+        try:
+            with open(comm_plan_path(self.directory, rank)) as f:
+                raw = json.load(f)
+        except (OSError, ValueError):
+            return None
+        # epoch-scoped like the barrier stamps: a previous
+        # incarnation's plan file must not satisfy this epoch's check.
+        # Known limitation, shared with the step barrier's stamps: a
+        # same-size restart into the same shared dir keeps the epoch,
+        # so a crashed run's plan file can satisfy the new run's check
+        # until the peer republishes (the elastic launcher's relaunch
+        # path bumps the epoch; a divergent peer still fails ITS OWN
+        # parity check and the survivor degrades to heartbeat-detected
+        # shrink, never a permanent wedge).
+        if int(raw.get("epoch", -1)) != self._epoch:
+            return None
+        return raw
+
+    def _check_comm_parity(self, mem: Membership) -> None:
+        """Bounded-wait for every member's plan record, then require
+        digest agreement.  Runs once, at the first guarded step."""
+        self._comm_checked = True
+        peers = [r for r in mem.world if r != self.rank]
+        deadline = time.monotonic() + self.comm_parity_timeout
+        records = {}
+        while True:
+            for r in peers:
+                if r not in records:
+                    rec = self._read_comm_plan(r)
+                    if rec is not None:
+                        records[r] = rec
+            if len(records) == len(peers):
+                break
+            if time.monotonic() >= deadline:
+                missing = sorted(set(peers) - set(records))
+                raise MXNetError(
+                    "comm-plan parity: rank(s) %s published no comm "
+                    "plan for epoch %d within %.1fs — refusing to "
+                    "enter the step collectives unverified (disable "
+                    "with MXTPU_COMM_PARITY=0)"
+                    % (missing, self._epoch, self.comm_parity_timeout))
+            time.sleep(max(self.poll_interval, 0.02))
+        for r in sorted(records):
+            rec = records[r]
+            if rec["digest"] == self._comm_digest:
+                continue
+            if COMM_PLAN_UNTRACED in (rec["digest"], self._comm_digest):
+                # one side could not trace its plan (Module.fit
+                # publishes the sentinel): parity for this pair is
+                # unverifiable — warn, don't kill a healthy fleet
+                self.logger.warning(
+                    "rank %d: comm-plan parity with rank %d is "
+                    "UNVERIFIED (digest %r vs %r) — one side could not "
+                    "trace its plan", self.rank, r,
+                    self._comm_digest, rec["digest"])
+                continue
+            mine, theirs = self._comm_keys or [], rec.get("plan") or []
+            idx = next((i for i, (a, b) in enumerate(zip(mine, theirs))
+                        if a != b), min(len(mine), len(theirs)))
+            local = mine[idx] if idx < len(mine) else "<absent>"
+            peer = theirs[idx] if idx < len(theirs) else "<absent>"
+            raise MXNetError(
+                "comm-plan parity check FAILED before step entry: rank "
+                "%d's plan digest %.12s != rank %d's %.12s — the ranks "
+                "would issue DIVERGENT collectives and wedge inside "
+                "XLA.  First differing collective at plan index %d: "
+                "rank %d has %s, rank %d has %s (%d vs %d entries "
+                "total).  Fix the rank-conditioned program divergence "
+                "(tools/comm_lint.py names source-level suspects via "
+                "the rank-divergent-collective rule)."
+                % (self.rank, self._comm_digest, r, rec["digest"], idx,
+                   self.rank, local, r, peer, len(mine), len(theirs)))
+        self.logger.info(
+            "rank %d: comm-plan parity OK across world %s (digest "
+            "%.12s, %d collectives)", self.rank, mem.world,
+            self._comm_digest, len(self._comm_keys or []))
+
     # ------------------------------------------------------------ guard
     def guard(self, step: Optional[int] = None) -> Membership:
         """The collective-entry guard: call once per step, before the
@@ -328,6 +463,12 @@ class ElasticCoordinator:
             self._mem_cache = self._check_membership()
             self._scan(self._mem_cache)
         mem = self._mem_cache
+        if self._comm_digest is not None and not self._comm_checked \
+                and len(mem.world) > 1:
+            # plan parity BEFORE the first barrier commit: a divergent
+            # rank must fail loudly while every member is still outside
+            # the step collectives
+            self._check_comm_parity(mem)
         if len(mem.world) > 1:
             self._barrier(step, mem)
         return mem

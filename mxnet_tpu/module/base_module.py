@@ -254,6 +254,31 @@ class BaseModule(object):
                     self._quarantine_blamed(record, _elastic)
                 _blame_cb._fit_wired = True
                 trainer.on_integrity_blame = _blame_cb
+        # cross-rank comm-plan parity (docs/how_to/static_analysis.md
+        # "Communication analysis"): stamp this rank's static comm-plan
+        # digest into the elastic shared dir BEFORE the first step; the
+        # coordinator's first guard refuses to enter the step
+        # collectives until every member's digest matches, so a
+        # rank-divergent program fails loudly pre-step instead of
+        # wedging inside XLA.  MXTPU_COMM_PARITY=0 disarms.
+        if elastic is not None and trainer is not None and \
+                os.environ.get("MXTPU_COMM_PARITY", "1") != "0":
+            try:
+                elastic.publish_comm_plan(trainer.comm_plan())
+            except Exception as e:                  # noqa: BLE001
+                # an untraceable plan downgrades parity to UNVERIFIED —
+                # publish the sentinel so peers log a warning instead of
+                # dying on this rank's missing stamp; never kill a
+                # training run over a lint trace
+                self.logger.warning(
+                    "comm-plan parity unverifiable: tracing this rank's "
+                    "comm plan failed (%s)", e)
+                from ..elastic import COMM_PLAN_UNTRACED
+                try:
+                    elastic.publish_comm_plan(
+                        [], digest=COMM_PLAN_UNTRACED)
+                except Exception:                   # noqa: BLE001
+                    pass                # shared-dir I/O: peers time out
         rollbacks = 0
         try:
             epoch = begin_epoch

@@ -19,7 +19,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .mesh import get_mesh, shard_map as _shard_map
 
 __all__ = ["global_allreduce", "barrier", "psum_over_mesh",
-           "broadcast_from_rank0", "lowp_allreduce", "lowp_comm_bytes"]
+           "broadcast_from_rank0", "lowp_allreduce", "lowp_comm_bytes",
+           "collective_wire_bytes"]
 
 
 def _process_count():
@@ -143,6 +144,48 @@ def lowp_comm_bytes(shape, n, comm_itemsize=2, keep_shard=False):
         ag = 0 if keep_shard else (n - 1) / n * size * comm_itemsize
         return rs + ag
     return (n - 1) * size * comm_itemsize
+
+
+def collective_wire_bytes(primitive: str, elements: int, itemsize: int,
+                          n: int) -> int:
+    """Predicted per-replica wire bytes for ONE invocation of a
+    collective primitive, as it appears in a jaxpr — the static byte
+    model behind ``mxnet_tpu/analysis/comm_passes.py``'s comm plans
+    (and, composed per-leaf, :func:`lowp_comm_bytes`).
+
+    ``elements`` is the element count of the primitive's OPERAND (the
+    local shard a replica feeds in — what the jaxpr invar aval shows),
+    ``itemsize`` its dtype width, ``n`` the product of the named axis
+    sizes the collective runs over.  Ring-algorithm accounting, the
+    same model XLA's cost analysis and ``lowp_comm_bytes`` use:
+
+    * ``psum``/``pmean``/``pmax``/``pmin`` (all-reduce): the ring
+      all-reduce moves each byte twice, minus the locally-owned chunk —
+      ``2*(n-1)/n * |x|``.
+    * ``reduce_scatter``: the reduce phase alone — ``(n-1)/n * |x|``.
+    * ``all_gather``: the operand is the LOCAL shard; a replica
+      receives the other ``n-1`` shards — ``(n-1) * |x|``.
+    * ``all_to_all``: every replica keeps 1/n of its buffer and ships
+      the rest — ``(n-1)/n * |x|``.
+    * ``ppermute``: one neighbor hop of the whole buffer — ``|x|``.
+
+    Unknown primitives predict 0 (and the comm-plan extractor only
+    feeds known ones)."""
+    if n <= 1:
+        return 0
+    size = int(elements) * int(itemsize)
+    if primitive in ("psum", "pmean", "pmax", "pmin", "psum2",
+                     "all_reduce"):
+        return int(2 * (n - 1) / n * size)
+    if primitive in ("reduce_scatter", "psum_scatter"):
+        return int((n - 1) / n * size)
+    if primitive == "all_gather":
+        return int((n - 1) * size)
+    if primitive == "all_to_all":
+        return int((n - 1) / n * size)
+    if primitive == "ppermute":
+        return size
+    return 0
 
 
 def barrier():

@@ -1540,6 +1540,94 @@ class Trainer:
         return analysis.lint_trainer(self, config=config,
                                      input_dtypes=input_dtypes)
 
+    # ------------------------------------------------ lowered programs
+    def abstract_step_args(self, input_dtypes: Optional[Dict] = None):
+        """The fused step's argument pytree as ``ShapeDtypeStruct``s —
+        exactly what ``_step_fn`` consumes, so ``jax.make_jaxpr`` can
+        re-derive the step program without touching device state.
+        Shared by the lint (``analysis.lint_trainer``) and comm
+        (:meth:`comm_plan`) paths so both analyze the SAME program.
+        ``input_dtypes`` overrides traced batch dtypes (name -> dtype)
+        for int-token / uint8-pipeline models; unlisted inputs trace
+        float32."""
+        if self._step_fn is None or self.params is None:
+            raise MXNetError("abstract_step_args needs a bound, "
+                             "initialized Trainer (bind() + "
+                             "init_params() first)")
+        input_dtypes = input_dtypes or {}
+        sds = lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype)  # noqa: E731
+        sent = self._sent
+        return (
+            {n: sds(v) for n, v in self.params.items()},
+            {n: sds(v) for n, v in self.aux.items()},
+            jax.tree_util.tree_map(sds, self.opt_state),
+        ) + ((jax.tree_util.tree_map(sds, sent),) if sent is not None
+             else ()) + (
+            {n: jax.ShapeDtypeStruct(
+                tuple(s), np.dtype(input_dtypes.get(n, np.float32)))
+             for n, s in self._input_shapes.items()},
+            jnp.float32(0.01), jnp.int32(1), jax.random.key(0),
+        )
+
+    def step_jaxpr(self, input_dtypes: Optional[Dict] = None,
+                   x64: bool = False):
+        """The fused step traced to its ClosedJaxpr (pure
+        ``jax.make_jaxpr`` — no device execution).  ``x64=True`` traces
+        under ``enable_x64`` so an f64 widening APPEARS instead of
+        being silently truncated (the lint path); the comm path traces
+        plain, seeing the wire dtypes the program actually runs."""
+        args = self.abstract_step_args(input_dtypes)
+        if x64:
+            from jax.experimental import enable_x64
+            with enable_x64():
+                return jax.make_jaxpr(self._step_fn)(*args)
+        return jax.make_jaxpr(self._step_fn)(*args)
+
+    def comm_plan(self, input_dtypes: Optional[Dict] = None):
+        """The step's ordered comm plan: every collective the compiled
+        step will issue, with axis, dtype, element count, predicted
+        per-chip wire bytes, and named-scope layer provenance
+        (``analysis.comm_passes.CommEntry``).
+
+        Two sources, by construction complementary (docs/how_to/
+        static_analysis.md "Communication analysis"):
+
+        * **jaxpr-extracted** — explicit collectives in the traced
+          program: the shard_map'd bf16 gradient wire
+          (``lowp_allreduce``'s all_to_all / all_gather), shard_map'd
+          parallelism bodies.
+        * **spmd-synthesized** — on the plain SPMD path the gradient
+          psum is inserted by GSPMD at compile time and never appears
+          as a jaxpr equation; the trainer synthesizes those entries
+          from its own sharding plan with the SAME analytic model as
+          :meth:`grad_comm_bytes_per_step`, one psum per param leaf
+          (x ``grad_accum`` — the SPMD psum lives inside each scan
+          iteration).
+
+        The plan total therefore agrees with
+        ``grad_comm_bytes_per_step`` (bench.py asserts <= 5% —
+        ``comm_model_gb_per_step``), and its digest
+        (``analysis.plan_digest``) is the cross-rank parity token the
+        elastic guard checks before the first step."""
+        from ..analysis import comm_passes
+        from .collectives import collective_wire_bytes
+        axis_sizes = dict(self.mesh.shape) if self.mesh is not None else {}
+        plan = comm_passes.extract_comm_plan(
+            self.step_jaxpr(input_dtypes), axis_sizes)
+        n = self._data_axis_size()
+        if n > 1 and not self._lowp_on:
+            # GSPMD-implied gradient reduction (no jaxpr equation to
+            # extract): one data-axis psum per param leaf, fired per
+            # microbatch
+            for nm in self.param_names:
+                size = int(np.prod(tuple(self._arg_shapes[nm]) or (1,)))
+                wire = collective_wire_bytes("psum", size, 4, n)
+                plan.append(comm_passes.CommEntry(
+                    len(plan), "psum", "data", "float32", size,
+                    wire * self.grad_accum, layer=nm, bwd=True,
+                    repeat=self.grad_accum, source="spmd"))
+        return plan
+
     def get_opt_states(self) -> bytes:
         """Serialize (num_update, optimizer state pytree[, sentinel
         state]) — the fused analog of ``Updater.get_states`` (reference

@@ -157,6 +157,39 @@ def test_f64_cast_caught_at_both_levels_with_provenance():
     assert wide and wide[0].layer == "widen"      # named-scope provenance
 
 
+def test_jaxpr_passes_see_inside_shard_map_with_provenance():
+    """The sub-jaxpr recursion fix: a hazard INSIDE a shard_map body is
+    (a) visible to the jaxpr rules and (b) attributed to the scope
+    applied AROUND the shard_map call — before the scoped recursion,
+    sub-jaxpr equations only carried their body-relative name stack and
+    everything under an outer scope reported ``(unattributed)``."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental import enable_x64
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.mesh import shard_map
+
+    mesh = make_mesh({"data": 2}, jax.devices()[:2])
+
+    def body(x):
+        return jax.lax.psum(x.astype(jnp.float64), "data")
+
+    def prog(x):
+        with jax.named_scope("commlayer"):
+            y = shard_map(body, mesh=mesh, in_specs=P("data"),
+                          out_specs=P("data"), check_rep=False)(x)
+        return y.astype(jnp.float32)
+
+    with enable_x64():
+        jaxpr = jax.make_jaxpr(prog)(
+            jax.ShapeDtypeStruct((4, 8), np.float32))
+    out = list(analysis.get_pass("f64-widening").run(
+        analysis.PassContext(jaxpr=jaxpr)))
+    assert out, "the widening inside the shard_map body must be seen"
+    assert out[0].layer == "commlayer"         # outer-scope provenance
+
+
 def test_host_callback_pass():
     import jax
 

@@ -86,22 +86,12 @@ def main(argv=None):
                                   as_json=args.json,
                                   max_findings=args.max_findings))
 
-    # NOTE: filter_severity only trims what is SHOWN above; the ratchet
-    # below always judges error-severity findings, which a severity
-    # filter at or above "error" cannot hide
-    if args.write_baseline:
-        path = analysis.write_baseline(reports, path=RACE_BASELINE_PATH)
-        print("concurrency-lint: baseline written -> %s" % path)
-        return 0
-    if args.check:
-        ok, msgs = analysis.check_baseline(reports,
-                                           path=RACE_BASELINE_PATH)
-        for m in msgs:
-            print("concurrency-lint: %s" % m)
-        print("concurrency-lint: baseline gate %s"
-              % ("OK" if ok else "FAILED"))
-        return 0 if ok else 1
-    return 0
+    # NOTE: filter_severity only trims what is SHOWN above; the shared
+    # ratchet (analysis.run_gate) always judges error-severity
+    # findings, which a severity filter at or above "error" cannot hide
+    return analysis.run_gate(reports, "concurrency-lint",
+                             check=args.check, write=args.write_baseline,
+                             path=RACE_BASELINE_PATH)
 
 
 if __name__ == "__main__":

@@ -215,17 +215,10 @@ def main(argv=None):
                                   as_json=args.json,
                                   max_findings=args.max_findings))
 
-    if args.write_baseline:
-        path = analysis.write_baseline(reports)
-        print("graph-lint: baseline written -> %s" % path)
-        return 0
-    if args.check:
-        ok, msgs = analysis.check_baseline(reports)
-        for m in msgs:
-            print("graph-lint: %s" % m)
-        print("graph-lint: baseline gate %s" % ("OK" if ok else "FAILED"))
-        return 0 if ok else 1
-    return 0
+    # shared ratchet block (analysis.run_gate — graph, concurrency, and
+    # comm lint all gate through the same baseline logic)
+    return analysis.run_gate(reports, "graph-lint", check=args.check,
+                             write=args.write_baseline)
 
 
 if __name__ == "__main__":
