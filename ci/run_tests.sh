@@ -143,24 +143,38 @@ stage "comm lint gate (static collective-communication analysis)"
 # time, docs/how_to/static_analysis.md "Communication analysis"
 python tools/comm_lint.py --check
 
+stage "runtime telemetry suite (metrics registry / spans / trace export)"
+# the unified-observability layer: registry snapshot/merge, serving
+# request + training step span trees, correlation-ID propagation
+# across the scheduler thread, JSONL -> Chrome round trip, off-mode
+# no-op sites, the obs_report closure gate, and the exporter-thread
+# leak check.  HARD timeout: a wedged exporter thread or a future that
+# never settles must FAIL this stage, not hang the suite —
+# docs/how_to/observability.md
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_obs.py -q
+
 stage "concurrency sanitizer gate (static lint + MXTPU_TSAN=1 lockset sweep)"
 # half 1: the AST thread-safety rules over mxnet_tpu/ (no imports, no
 # devices) gated on RACE_BASELINE.json — unnamed threads, undeclared
 # daemon policy, unlocked thread-target mutation, blocking calls under
 # a lock.  half 2: re-run the serving + stream-pipeline + elastic unit
-# suites with the runtime lockset/lock-order recorder ON, then replay
-# the combined event log and FAIL on any non-baseline finding (the
-# committed baseline is all-zeros: a real race gets fixed, not
-# baselined).  HARD timeout: an instrumented deadlock must fail this
-# stage, not hang the suite.  Measured overhead of the instrumented
-# sweep is ~1.1x the plain run (well inside the 2x budget) —
-# docs/how_to/static_analysis.md
+# suites with the runtime lockset/lock-order recorder ON — and the
+# span recorder armed too (MXTPU_OBS=1): the obs layer's locks and the
+# registry mutex nest inside the subsystem locks they serve, and the
+# sweep proves the discipline holds under load (new locks must keep
+# RACE_BASELINE.json all-zeros) — then replay the combined event log
+# and FAIL on any non-baseline finding (the committed baseline is
+# all-zeros: a real race gets fixed, not baselined).  HARD timeout: an
+# instrumented deadlock must fail this stage, not hang the suite.
+# Measured overhead of the instrumented sweep is ~1.1x the plain run
+# (well inside the 2x budget) — docs/how_to/static_analysis.md
 python tools/concurrency_lint.py --check
 TSAN_LOG="$(mktemp)"
-timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 \
+timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 MXTPU_OBS=1 \
     MXTPU_TSAN_LOG="$TSAN_LOG" \
     python -m pytest tests/test_serving.py tests/test_serving_overload.py \
-        tests/test_stream_pipeline.py \
+        tests/test_stream_pipeline.py tests/test_obs.py \
         tests/test_elastic.py tests/test_integrity.py -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"
@@ -234,12 +248,13 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_elastic.py, test_integrity.py, test_resilience.py,
+# test_elastic.py, test_integrity.py, test_obs.py, test_resilience.py,
 # test_serving.py, test_serving_overload.py, test_stream_pipeline.py
 # and test_zero_accum.py already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_elastic.py \
     --ignore=tests/test_integrity.py \
+    --ignore=tests/test_obs.py \
     --ignore=tests/test_resilience.py \
     --ignore=tests/test_serving.py \
     --ignore=tests/test_serving_overload.py \

@@ -165,6 +165,7 @@ from . import profiler
 from . import rtc
 from . import operator
 from .operator import CustomOp, CustomOpProp
+from . import obs
 from . import parallel
 from . import analysis
 from . import serving

@@ -55,6 +55,7 @@ from .base import MXNetError
 from . import _tsan
 from . import faults as _faults
 from . import health as _health
+from . import obs as _obs
 from .parallel.collectives import _process_count, _process_index
 from .resilience import retry_io
 
@@ -165,6 +166,13 @@ class ElasticShrink(Exception):
     def __init__(self, membership: Membership, dead=()):
         self.membership = membership
         self.dead = sorted(dead)
+        # registry-backed event count (docs/how_to/observability.md):
+        # one obs.snapshot() answers "how many shrinks has this
+        # process observed" without grepping logs.  A revocation counts
+        # ONLY under elastic.revocations (its subclass ctor) — this
+        # rank was removed, it did not observe a surviving-world shrink
+        if not isinstance(self, ElasticRevoked):
+            _obs.counter("elastic.shrinks").inc()
         super().__init__(
             "membership epoch %d: world=%s dead=%s — exit and resume "
             "under the new world" % (membership.epoch, membership.world,
@@ -176,6 +184,10 @@ class ElasticRevoked(ElasticShrink):
     possibly a stalled stamper on a live process, the split brain).  It
     must exit cleanly without touching the checkpoint line: the
     surviving world has already moved on."""
+
+    def __init__(self, membership: Membership, dead=()):
+        _obs.counter("elastic.revocations").inc()
+        super().__init__(membership, dead=dead)
 
 
 class ElasticCoordinator:
@@ -451,27 +463,31 @@ class ElasticCoordinator:
             # BEFORE the barrier stamp — peers must never believe this
             # rank committed to the step
             os._exit(137)
-        now = time.monotonic()
-        if self._mem_cache is None \
-                or now - self._last_scan >= self.check_interval:
-            # membership read and liveness scan share the throttle: on
-            # fast steps an unconditional per-step json read of the
-            # shared record would be the same metadata storm the
-            # barrier loop avoids; epoch observation lag stays bounded
-            # by one scan period
-            self._last_scan = now
-            self._mem_cache = self._check_membership()
-            self._scan(self._mem_cache)
-        mem = self._mem_cache
-        if self._comm_digest is not None and not self._comm_checked \
-                and len(mem.world) > 1:
-            # plan parity BEFORE the first barrier commit: a divergent
-            # rank must fail loudly while every member is still outside
-            # the step collectives
-            self._check_comm_parity(mem)
-        if len(mem.world) > 1:
-            self._barrier(step, mem)
-        return mem
+        # the fit-loop "elastic guard" phase on the span timeline:
+        # nests under fit's train.step root when called from there
+        with _obs.span("elastic.guard",
+                       attrs={"step": step} if _obs.OBS else None):
+            now = time.monotonic()
+            if self._mem_cache is None \
+                    or now - self._last_scan >= self.check_interval:
+                # membership read and liveness scan share the throttle:
+                # on fast steps an unconditional per-step json read of
+                # the shared record would be the same metadata storm
+                # the barrier loop avoids; epoch observation lag stays
+                # bounded by one scan period
+                self._last_scan = now
+                self._mem_cache = self._check_membership()
+                self._scan(self._mem_cache)
+            mem = self._mem_cache
+            if self._comm_digest is not None and not self._comm_checked \
+                    and len(mem.world) > 1:
+                # plan parity BEFORE the first barrier commit: a
+                # divergent rank must fail loudly while every member is
+                # still outside the step collectives
+                self._check_comm_parity(mem)
+            if len(mem.world) > 1:
+                self._barrier(step, mem)
+            return mem
 
     def _check_membership(self) -> Membership:
         mem = self.membership()
@@ -665,6 +681,7 @@ class ElasticCoordinator:
             self._publish(mem, new)
             cur = self.membership()
             if rank not in cur.world:
+                _obs.counter("elastic.quarantines").inc()
                 self.logger.warning(
                     "rank %d: QUARANTINED rank %d (integrity outvote) — "
                     "membership epoch %d, surviving world %s", self.rank,

@@ -79,6 +79,10 @@ class IntegrityError(MXNetError):
     def __init__(self, message: str, record: Optional[dict] = None):
         super().__init__(message)
         self.record = record or {}
+        # registry-backed event count: every constructed IntegrityError
+        # IS a detected divergence, whichever layer raised it
+        from . import obs as _obs
+        _obs.counter("integrity.divergences").inc()
 
 
 # ----------------------------------------------------------------- jnp
@@ -197,6 +201,7 @@ def verify_manifest_record(record: dict,
     every old reader), but a ``refused`` record — the saver itself
     declined to fingerprint a state its replicas disagreed on — never
     verifies, whatever reader asks."""
+    from . import obs as _obs
     if not record:
         return True
     if record.get("refused"):
@@ -204,6 +209,7 @@ def verify_manifest_record(record: dict,
             logger.warning(
                 "%s recorded a REFUSED fingerprint (state diverged at "
                 "save): %s", what, record["refused"])
+        _obs.counter("integrity.verify_refused").inc()
         return False
     if record.get("algo") != ALGO:
         return True
@@ -223,6 +229,7 @@ def verify_manifest_record(record: dict,
             what, global_fp, record.get("global") or 0,
             bad or "<global-only>",
             (", missing %s" % missing) if missing else "")
+    _obs.counter("integrity.verify_failed").inc()
     return False
 
 

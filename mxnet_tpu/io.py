@@ -32,6 +32,7 @@ from .base import MXNetError, mx_real_t, _dtype
 from .ndarray import NDArray, array
 from . import _tsan
 from . import faults as _faults
+from . import obs as _obs
 from . import ndarray as nd
 from . import recordio as _recordio
 from . import random as _random
@@ -420,14 +421,18 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         # stage-attribution counters are written by BOTH sides of the
         # pipeline (worker: upload/source wall; consumer: wait/hit
         # tallies) and read whole by stats() — one lock, one snapshot,
-        # no mid-update reads (the lockset checker gates this)
+        # no mid-update reads (the lockset checker gates this).  The
+        # VALUES live in the process-wide metrics registry under this
+        # iterator's scope, so one obs.snapshot() sees every stage; the
+        # _stats_lock stays the outer GROUP guard (registry mutex nests
+        # inside it, one direction only).
         self._stats_lock = _tsan.lock("io.DeviceUploadIter._stats_lock")
-        self.upload_s = 0.0
-        self.source_s = 0.0
-        self.consumer_wait_s = 0.0
-        self.batches_staged = 0
-        self._ready_hits = 0
-        self._next_calls = 0
+        self._obs_scope = _obs.REGISTRY.scope("io.upload")
+        self._c = {k: _obs.REGISTRY.counter(
+            "%s.%s" % (self._obs_scope, k), initial=z)
+            for k, z in (("upload_s", 0.0), ("source_s", 0.0),
+                         ("consumer_wait_s", 0.0), ("batches_staged", 0),
+                         ("ready_hits", 0), ("next_calls", 0))}
         self._worker = None
         self._ended = False
         # the worker starts LAZILY on the first next(): a reset (or
@@ -453,34 +458,44 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
     def _run(self):
         import time as _time
         import jax
+        nbatch = 0
         try:
             while not self._stop.is_set():
+                # span per staged batch (MXTPU_OBS=1): io.source =
+                # blocked on the inner iterator (decode), io.upload =
+                # device_put + readiness — the uploader's rows on the
+                # unified trace timeline.  corr is only FORMATTED when
+                # recording (the off contract: no per-batch allocation)
+                corr = ("io%d" % nbatch) if _obs.OBS else None
                 t0 = _time.perf_counter()
                 try:
-                    b = self.it.next()
+                    with _obs.span("io.source", corr=corr, parent=None):
+                        b = self.it.next()
                 except StopIteration:
                     self._put(self._END)
                     return
                 dt_src = _time.perf_counter() - t0
                 t0 = _time.perf_counter()
-                # resolve callable shardings lazily, once per batch
-                data_sh = self._data_shardings() \
-                    if callable(self._data_shardings) \
-                    else self._data_shardings
-                label_sh = self._label_shardings() \
-                    if callable(self._label_shardings) \
-                    else self._label_shardings
-                data = [self._upload(a, data_sh, i)
-                        for i, a in enumerate(b.data)]
-                label = [self._upload(a, label_sh, i)
-                         for i, a in enumerate(b.label or [])]
-                jax.block_until_ready([a.data for a in data + label])
+                with _obs.span("io.upload", corr=corr, parent=None):
+                    # resolve callable shardings lazily, once per batch
+                    data_sh = self._data_shardings() \
+                        if callable(self._data_shardings) \
+                        else self._data_shardings
+                    label_sh = self._label_shardings() \
+                        if callable(self._label_shardings) \
+                        else self._label_shardings
+                    data = [self._upload(a, data_sh, i)
+                            for i, a in enumerate(b.data)]
+                    label = [self._upload(a, label_sh, i)
+                             for i, a in enumerate(b.label or [])]
+                    jax.block_until_ready([a.data for a in data + label])
+                nbatch += 1
                 with self._stats_lock:
                     if _tsan.TSAN:
                         _tsan.note_write("io.DeviceUploadIter.stats")
-                    self.source_s += dt_src
-                    self.upload_s += _time.perf_counter() - t0
-                    self.batches_staged += 1
+                    self._c["source_s"].inc(dt_src)
+                    self._c["upload_s"].inc(_time.perf_counter() - t0)
+                    self._c["batches_staged"].inc()
                 staged = DataBatch(data=data, label=label, pad=b.pad,
                                    index=b.index,
                                    provide_data=b.provide_data,
@@ -579,15 +594,19 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         if _tsan.TSAN:
             _tsan.note_read("io.DeviceUploadIter.staging", lockfree=True,
                             reason="queue.Queue handoff (internal lock)")
-        item = self._q.get()
+        with _obs.span("io.wait",
+                       attrs={"ready": ready} if _obs.OBS else None):
+            # consumer side of the pipeline: nests under fit.fetch when
+            # the fit loop is the consumer (thread-local span stack)
+            item = self._q.get()
         dt_wait = _time.perf_counter() - t0
         with self._stats_lock:
             if _tsan.TSAN:
                 _tsan.note_write("io.DeviceUploadIter.stats")
-            self._next_calls += 1
+            self._c["next_calls"].inc()
             if ready:
-                self._ready_hits += 1
-            self.consumer_wait_s += dt_wait
+                self._c["ready_hits"].inc()
+            self._c["consumer_wait_s"].inc(dt_wait)
         if item is self._END:
             self._ended = True
             if self._err is not None:
@@ -616,14 +635,18 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
         One atomic snapshot under the stats lock: the worker updates
         these counters mid-flight, and an unlocked read could pair a
         fresh ``upload_s`` with a stale ``batches_staged`` (the race
-        the concurrency sanitizer flags)."""
+        the concurrency sanitizer flags).  The counters themselves are
+        registry-backed (scope ``io.upload<N>``), so ``obs.snapshot()``
+        reports the same numbers process-wide."""
         with self._stats_lock:
             if _tsan.TSAN:
                 _tsan.note_read("io.DeviceUploadIter.stats")
-            upload_s, source_s = self.upload_s, self.source_s
-            consumer_wait_s = self.consumer_wait_s
-            staged = self.batches_staged
-            hits, calls = self._ready_hits, self._next_calls
+            upload_s = self._c["upload_s"].value
+            source_s = self._c["source_s"].value
+            consumer_wait_s = self._c["consumer_wait_s"].value
+            staged = self._c["batches_staged"].value
+            hits = self._c["ready_hits"].value
+            calls = self._c["next_calls"].value
         return {"upload_s": round(upload_s, 3),
                 "source_s": round(source_s, 3),
                 "decode_wait_s": round(source_s, 3),
@@ -633,6 +656,23 @@ class DeviceUploadIter(_CurrentBatchAccessors, DataIter):
                 "batches_staged": staged,
                 "chunks": self._chunks,
                 "depth": self._depth}
+
+    # raw-counter views kept for callers that read the old attributes
+    @property
+    def upload_s(self):
+        return self._c["upload_s"].value
+
+    @property
+    def source_s(self):
+        return self._c["source_s"].value
+
+    @property
+    def consumer_wait_s(self):
+        return self._c["consumer_wait_s"].value
+
+    @property
+    def batches_staged(self):
+        return self._c["batches_staged"].value
 
 
 def _make_device_augment(crop, chans, rand_crop, rand_mirror, mean, std,

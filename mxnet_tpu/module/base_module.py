@@ -17,6 +17,7 @@ from collections import namedtuple
 
 from .. import metric as metric_mod
 from .. import ndarray
+from .. import obs as _obs
 from ..ndarray import NDArray
 
 BatchEndParam = namedtuple("BatchEndParams",
@@ -312,8 +313,13 @@ class BaseModule(object):
                             "step(s) so far", epoch, skips)
                 if ckpt_mgr is not None and \
                         (epoch + 1) % checkpoint_period == 0:
-                    ckpt_mgr.save(self, epoch + 1, arg_params=arg_snap,
-                                  aux_params=aux_snap)
+                    with _obs.span("fit.checkpoint",
+                                   corr="e%d" % (epoch + 1),
+                                   parent=None,
+                                   attrs={"epoch": epoch + 1}):
+                        ckpt_mgr.save(self, epoch + 1,
+                                      arg_params=arg_snap,
+                                      aux_params=aux_snap)
                 if epoch_end_callback is not None:
                     for cb in _as_list(epoch_end_callback):
                         cb(epoch, self.symbol, arg_snap, aux_snap)
@@ -406,6 +412,9 @@ class BaseModule(object):
             "re-stepping [rollback %d/%d]",
             record.get("step"), record.get("mode"), record.get("blamed"),
             ck.epoch, ck.step, rollbacks, max_rollbacks)
+        # counted HERE, once the rollback actually happens — a refusal
+        # (cap hit, no verified checkpoint) must not inflate the figure
+        _obs.counter("integrity.rollbacks").inc()
         _, arg_params, aux_params = ck.load_params()
         self.set_params(arg_params, aux_params)
         if ck.states_path and getattr(self, "optimizer_initialized",
@@ -476,23 +485,41 @@ class BaseModule(object):
         eval_metric.reset()
         tic = time.time()
         data_iter = iter(train_data)
+        trainer = getattr(self, "_trainer", None)
         nbatch = 0
         while True:
+            # the step's correlation ID: the update counter the fused
+            # trainer is ABOUT to take (spans recorded inside
+            # Trainer.step carry the same "s<n>", so fetch/guard/h2d/
+            # dispatch/sync join into one per-step breakdown).  The
+            # classic-executor fallback counts cumulatively on the
+            # module — a per-epoch nbatch would alias epoch 0's step 1
+            # with epoch 1's and the report would fold them into one
+            # row.  Only formatted when recording — off mode pays no
+            # per-step allocation at these sites
+            on = _obs.OBS
+            self._obs_steps = getattr(self, "_obs_steps", 0) + 1
+            ncorr = ("s%d" % (trainer.num_update + 1
+                              if trainer is not None
+                              else self._obs_steps)) if on else None
             try:
-                data_batch = retry_io(lambda: next(data_iter),
-                                      what="train batch fetch",
-                                      logger=self.logger)
+                with _obs.span("fit.fetch", corr=ncorr, parent=None):
+                    data_batch = retry_io(lambda: next(data_iter),
+                                          what="train batch fetch",
+                                          logger=self.logger)
             except StopIteration:
                 break
-            if elastic is not None:
-                trainer = getattr(self, "_trainer", None)
-                elastic.guard(trainer.num_update + 1
-                              if trainer is not None else None)
-            if monitor is not None:
-                monitor.tic()
-            self.forward_backward(data_batch)
-            self.update()
-            self.update_metric(eval_metric, data_batch.label)
+            with _obs.span("train.step", corr=ncorr, parent=None,
+                           attrs={"epoch": epoch, "nbatch": nbatch}
+                           if on else None):
+                if elastic is not None:
+                    elastic.guard(trainer.num_update + 1
+                                  if trainer is not None else None)
+                if monitor is not None:
+                    monitor.tic()
+                self.forward_backward(data_batch)
+                self.update()
+                self.update_metric(eval_metric, data_batch.label)
             if monitor is not None:
                 monitor.toc_print()
             if batch_end_callback is not None:

@@ -1,0 +1,133 @@
+"""JSONL log parsing and Chrome-tracing rendering.
+
+The JSONL log (``MXTPU_OBS_LOG``) carries three line kinds:
+
+* ``{"k": "o", ...}`` — a span that was STILL OPEN at a flush (sid,
+  name, corr, parent, t0, thread name + ident; emitted lazily, once).
+  Exists so ``tools/obs_report.py --check`` can prove every declared
+  span site actually closed — an ``"o"`` with no matching ``"s"`` is a
+  leaked lifecycle.
+* ``{"k": "s", ...}`` — a span finished (the open fields plus ``t1``
+  and attrs).  The currency of every downstream consumer.
+* ``{"k": "m", ...}`` — a periodic metrics line: counter deltas since
+  the previous flush, gauge values, non-empty histogram snapshots.
+
+``chrome_trace`` renders finished spans as Chrome tracing ``X``
+(complete) events with **real thread ids** and ``thread_name``
+metadata rows, so a Perfetto load shows the decode workers, the upload
+stager, the serving scheduler, and the training loop on their own
+correctly-named rows — one timeline from data loader to serving
+response.  Timestamps are ``time.perf_counter`` microseconds, the same
+clock base the legacy ``profiler.py`` events use, so the two sources
+merge into one coherent dump (``profiler.dump_profile``).
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence, Union
+
+__all__ = ["parse_log", "span_events", "metric_events", "chrome_trace",
+           "dump_chrome", "RowAllocator"]
+
+
+class RowAllocator:
+    """Chrome display-tid allocator shared by :func:`chrome_trace` and
+    ``profiler.dump_profile``.  Rows key on (pid, ident, thread-name),
+    not ident alone: the OS REUSES thread idents, so a scheduler that
+    exited before the uploader started could hand its ident (and its
+    row) to a differently-named thread.  A reused ident gets a
+    synthesized display tid within its pid; one ``thread_name``
+    metadata row is appended to ``out`` per allocation, and the row
+    label (plus the span's recorded ident) stay truthful."""
+
+    def __init__(self, out):
+        self._out = out
+        self._row_of = {}
+        self._used = {}
+
+    def row(self, pid: int, tid: int, tname: str) -> int:
+        key = (pid, tid, tname)
+        d = self._row_of.get(key)
+        if d is None:
+            taken = self._used.setdefault(pid, set())
+            d = tid
+            while d in taken:
+                d += 1
+            taken.add(d)
+            self._row_of[key] = d
+            self._out.append({"ph": "M", "name": "thread_name",
+                              "pid": pid, "tid": d,
+                              "args": {"name": tname}})
+        return d
+
+
+def parse_log(path: str) -> List[Dict]:
+    """Events from a JSONL log, oldest first.  Torn lines (a killed
+    subprocess, an interleaved append) are skipped, not fatal."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(ev, dict) and ev.get("k") in ("o", "s", "m"):
+                events.append(ev)
+    return events
+
+
+def span_events(events: Sequence[Dict]) -> List[Dict]:
+    """The finished-span (``"k": "s"``) subset."""
+    return [e for e in events if e.get("k") == "s"
+            and e.get("t1") is not None]
+
+
+def metric_events(events: Sequence[Dict]) -> List[Dict]:
+    return [e for e in events if e.get("k") == "m"]
+
+
+def _as_event(sp) -> Dict:
+    """Accept both live Span objects and already-serialized dicts."""
+    return sp if isinstance(sp, dict) else sp.to_event()
+
+
+def chrome_trace(spans: Sequence[Union[Dict, object]],
+                 pid: int = 0,
+                 process_name: str = "mxtpu") -> Dict:
+    """Chrome tracing JSON (the ``chrome://tracing`` / Perfetto
+    format): one ``X`` event per finished span on its REAL thread row,
+    with ``thread_name`` metadata naming each row after the recording
+    thread (``MainThread``, ``mxtpu-serve-sched``, ``mxtpu-upload``,
+    ...).  Load the result with Perfetto's "Open trace file"."""
+    out = [{"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": process_name}}]
+    rows = RowAllocator(out)
+    events = []
+    for sp in spans:
+        e = _as_event(sp)
+        if e.get("t1") is None:
+            continue
+        tid = int(e.get("tid") or 0)
+        tname = e.get("th") or "thread-%d" % tid
+        args = {"corr": e.get("c"), "sid": e.get("sid"),
+                "parent": e.get("p")}
+        args.update(e.get("a") or {})
+        events.append({"name": e["n"], "cat": "obs", "ph": "X",
+                       "ts": round(e["t0"] * 1e6, 3),
+                       "dur": round((e["t1"] - e["t0"]) * 1e6, 3),
+                       "pid": pid, "tid": rows.row(pid, tid, tname),
+                       "args": args})
+    events.sort(key=lambda ev: ev["ts"])
+    return {"traceEvents": out + events,
+            "displayTimeUnit": "ms"}
+
+
+def dump_chrome(spans, fname: str, pid: int = 0,
+                process_name: str = "mxtpu") -> str:
+    with open(fname, "w") as f:
+        json.dump(chrome_trace(spans, pid=pid,
+                               process_name=process_name), f, indent=1)
+    return fname

@@ -1,0 +1,394 @@
+"""Structured spans with parent/child links and correlation IDs.
+
+A **span** is one timed segment of work (``serve.queue``,
+``train.h2d``, ``io.upload``) with a process-unique ``sid``, an
+optional parent span, a **correlation ID** naming the logical unit the
+segment belongs to (``r<rid>`` for a serving request, ``s<n>`` for a
+training update, ``b<rid>`` for a dispatched batch, ``io<k>`` for a
+staged input batch), the recording thread's name + ident, and free-form
+attrs.  Trees form two ways:
+
+* **same thread** — entering a span as a context manager pushes it on a
+  thread-local stack; a span started while another is entered becomes
+  its child and inherits its correlation ID.  This is how
+  ``train.h2d`` inside ``Trainer.step`` lands under ``fit``'s
+  ``train.step`` root without the layers knowing about each other.
+* **across threads** — an explicit ``parent=`` hands a span created on
+  one thread (a request root built in ``submit()``) to segments
+  recorded on another (the serving scheduler).  A parent remembers its
+  explicitly-parented children and, on finish, closes any still open —
+  so a request failed by a timeout path that never dispatched cannot
+  leak an unclosed ``serve.queue`` (``tools/obs_report.py --check``
+  gates on exactly this).
+
+The recorder buffers finished Span OBJECTS on the hot path and
+serializes at flush time (the <5% serving-overhead budget lives on
+this deferral): each flush writes one ``"k": "o"`` line per span still
+open that has not announced itself yet (how ``--check`` proves every
+declared site closes), one ``"k": "s"`` line per span finished since
+the previous flush, and a registry **metric-delta** line
+(``"k": "m"``).  Flushes are periodic (the ``mxtpu-obs-flush`` thread,
+``MXTPU_OBS_FLUSH_S``), size-triggered, and ``atexit`` — the
+``_tsan.py`` event-log discipline.  Paths are **per
+recorder**: a ``scoped()`` test recorder can never append to the log a
+live ``MXTPU_OBS_LOG`` run is collecting.  Finished spans also stay in
+an in-memory ring for the legacy ``profiler.dump_profile`` Chrome
+render and in-process consumers.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import _tsan
+from .registry import REGISTRY
+
+__all__ = ["Span", "SpanRecorder", "NULL_SPAN", "AUTO_PARENT"]
+
+_RING_MAX = 65536          # finished spans kept in memory
+_BUFFER_MAX = 65536        # pending JSONL lines (ring: oldest dropped)
+_FLUSH_EVERY = 256         # size-triggered flush threshold
+
+AUTO_PARENT = object()     # sentinel: parent = the caller thread's stack top
+
+
+class Span:
+    """One timed segment.  Use as a context manager for same-thread
+    nesting, or hold the object and call :meth:`finish` (idempotent,
+    optionally with an explicit end time) for cross-thread lifecycles."""
+
+    __slots__ = ("name", "sid", "parent", "corr", "t0", "t1", "thread",
+                 "tid", "_attrs", "_rec", "_kids", "_o_logged")
+
+    def __init__(self, rec, name: str, sid: int, parent: Optional[int],
+                 corr: Optional[str], attrs: Optional[Dict], t0: float,
+                 tid: int, thread: str):
+        self._rec = rec
+        self.name = name
+        self.sid = sid
+        self.parent = parent
+        self.corr = corr
+        self.t0 = t0
+        self.t1 = None
+        self.thread = thread
+        self.tid = tid
+        # attrs and the explicit-child list materialize LAZILY: most
+        # spans carry neither, and two dict/list allocations per span
+        # are measurable against the <5% serving budget
+        self._attrs = attrs
+        self._kids = None
+        self._o_logged = False
+
+    @property
+    def attrs(self) -> Dict:
+        a = self._attrs
+        if a is None:
+            a = self._attrs = {}
+        return a
+
+    def __enter__(self) -> "Span":
+        self._rec._push(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._rec._pop(self)
+        self.finish()
+        return False
+
+    def finish(self, t: Optional[float] = None) -> None:
+        self._rec.on_finish(self, t)
+
+    @property
+    def duration_s(self) -> Optional[float]:
+        return None if self.t1 is None else self.t1 - self.t0
+
+    def to_event(self) -> Dict:
+        """The close-event dict (what one JSONL ``"k": "s"`` line
+        holds) — the shared currency of the log, the replay, and the
+        Chrome render."""
+        ev = {"k": "s", "sid": self.sid, "n": self.name, "c": self.corr,
+              "p": self.parent, "t0": round(self.t0, 9),
+              "t1": round(self.t1, 9) if self.t1 is not None else None,
+              "th": self.thread, "tid": self.tid}
+        if self._attrs:
+            ev["a"] = self._attrs
+        return ev
+
+    def open_event(self) -> Dict:
+        ev = {"k": "o", "sid": self.sid, "n": self.name, "c": self.corr,
+              "p": self.parent, "t0": round(self.t0, 9),
+              "th": self.thread, "tid": self.tid}
+        return ev
+
+    def __repr__(self):
+        return "<Span %s sid=%d corr=%s %s>" % (
+            self.name, self.sid, self.corr,
+            "open" if self.t1 is None else
+            "%.3fms" % ((self.t1 - self.t0) * 1e3))
+
+
+class _NullSpan:
+    """The off-mode singleton: every note site gets THIS object —
+    no allocation, no lock, no event (the inert-site contract the
+    off-mode type assertions in ``tests/test_obs.py`` pin)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def finish(self, t=None):
+        pass
+
+    @property
+    def attrs(self):
+        return {}
+
+
+NULL_SPAN = _NullSpan()
+
+
+class SpanRecorder:
+    """Aggregating span recorder + JSONL exporter.  All shared state
+    lives behind one named lock; the per-thread span stack is
+    thread-local and needs none.  The file write happens OUTSIDE the
+    lock (the blocking-call-under-lock rule applies to us too)."""
+
+    def __init__(self, log_path: Optional[str] = None,
+                 flush_s: Optional[float] = None,
+                 start_flusher: bool = True,
+                 registry=None):
+        self.log_path = log_path
+        if flush_s is None:
+            try:
+                flush_s = float(os.environ.get("MXTPU_OBS_FLUSH_S", "")
+                                or 5.0)
+            except ValueError:
+                flush_s = 5.0
+        self.flush_s = flush_s
+        self.registry = registry if registry is not None else REGISTRY
+        # the span hot path is LOCK-FREE on CPython: span ids come from
+        # an itertools.count (atomic next()), the open-table and ring
+        # are a dict and a deque (GIL-atomic per operation), and the
+        # finish gate is `self._open.pop(sid)` — exactly one caller
+        # (explicit finish vs a parent's sweep) wins it.  _mu guards
+        # only the exporter buffer swap and the flush bookkeeping.
+        self._mu = _tsan.lock("obs.SpanRecorder._mu")
+        self._tls = threading.local()
+        self._sid = itertools.count(1)
+        self._open: Dict[int, Span] = {}
+        self.ring: collections.deque = collections.deque(maxlen=_RING_MAX)
+        self._buffer: List[str] = []
+        self._dropped = 0
+        self._last_counters: Dict[str, float] = {}
+        self._stop_ev = threading.Event()
+        self._kick = threading.Event()
+        self._flusher = None
+        # the exporter thread starts EAGERLY with the recorder (not
+        # lazily on the first span): a thread that first appears
+        # mid-test would trip the conftest mxtpu-* leak check even
+        # though it is owned here; close() stops it
+        if start_flusher:
+            self.ensure_flusher()
+
+    def ensure_flusher(self) -> None:
+        """Start the exporter thread if this recorder logs and has
+        none yet — the import path does this eagerly; a runtime
+        ``obs.enable()`` after import re-arms through here."""
+        if self.log_path and self.flush_s > 0 and self._flusher is None \
+                and not self._stop_ev.is_set():
+            self._flusher = threading.Thread(
+                target=self._flush_loop, name="mxtpu-obs-flush",
+                daemon=True)
+            self._flusher.start()
+
+    # ---------------------------------------------------- thread stack
+    def _stack(self) -> List[Span]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = []
+            self._tls.stack = st
+        return st
+
+    def _tinfo(self):
+        """(ident, name) of the calling thread, cached thread-locally —
+        ``threading.current_thread()`` twice per span is measurable on
+        the serving hot path."""
+        ti = getattr(self._tls, "tinfo", None)
+        if ti is None:
+            t = threading.current_thread()
+            ti = (t.ident or 0, t.name)
+            self._tls.tinfo = ti
+        return ti
+
+    def _push(self, sp: Span) -> None:
+        self._stack().append(sp)
+
+    def _pop(self, sp: Span) -> None:
+        st = self._stack()
+        if st and st[-1] is sp:
+            st.pop()
+        elif sp in st:
+            st.remove(sp)
+
+    def current(self) -> Optional[Span]:
+        st = self._stack()
+        return st[-1] if st else None
+
+    # ----------------------------------------------------------- spans
+    def start(self, name: str, corr: Optional[str] = None,
+              attrs: Optional[Dict] = None, parent=AUTO_PARENT) -> Span:
+        if parent is AUTO_PARENT:
+            st = self._stack()
+            parent = st[-1] if st else None
+        if corr is None and parent is not None:
+            corr = parent.corr
+        tid, tname = self._tinfo()
+        t0 = time.perf_counter()
+        sp = Span(self, name, next(self._sid),
+                  parent.sid if parent is not None else None,
+                  corr, attrs, t0, tid, tname)
+        if parent is not None:
+            kids = parent._kids
+            if kids is None:
+                kids = parent._kids = []
+            kids.append(sp)
+        self._open[sp.sid] = sp
+        return sp
+
+    def on_finish(self, sp: Span, t: Optional[float] = None) -> None:
+        if self._open.pop(sp.sid, None) is None:
+            return      # already finished (the pop is the atomic gate)
+        sp.t1 = time.perf_counter() if t is None else t
+        self.ring.append(sp)
+        # hot path buffers the Span OBJECT; serialization happens at
+        # flush time, off the serving scheduler / submit path (the <5%
+        # obs_overhead_pct budget lives or dies on this deferral)
+        if self.log_path is not None:
+            with self._mu:
+                self._buffer.append(sp)
+                if len(self._buffer) > _BUFFER_MAX:
+                    del self._buffer[:len(self._buffer) - _BUFFER_MAX]
+                    self._dropped += 1
+            self.maybe_flush()
+        kids = sp._kids
+        if kids:
+            for k in kids:
+                # a parent closing sweeps its still-open explicit
+                # children (a shed request's queue span, a crashed
+                # batch's segment)
+                if k.t1 is None:
+                    k.finish(t=sp.t1)
+
+    def open_spans(self) -> List[Span]:
+        return self._open_snapshot()
+
+    def _open_snapshot(self) -> List[Span]:
+        # the open-table is mutated lock-free by the hot path; iterate
+        # over an atomic dict.copy() (one C-level op under the GIL), so
+        # concurrent churn can never raise mid-iteration
+        return list(self._open.copy().values())
+
+    def _metrics_line(self) -> Optional[str]:
+        """One ``"k": "m"`` line per flush: counter DELTAS since the
+        last flush (so replaying a log reconstructs rates), gauges and
+        histogram snapshots whole."""
+        snap = self.registry.snapshot()
+        with self._mu:
+            deltas = {}
+            for k, v in snap["counters"].items():
+                d = v - self._last_counters.get(k, 0)
+                if d:
+                    deltas[k] = round(d, 6) if isinstance(d, float) else d
+            self._last_counters = dict(snap["counters"])
+            dropped = self._dropped
+        if not deltas and not snap["gauges"] and not snap["histograms"]:
+            return None
+        ev = {"k": "m", "t": round(time.perf_counter(), 9), "c": deltas,
+              "g": snap["gauges"],
+              "h": {k: h for k, h in snap["histograms"].items()
+                    if h["count"]}}
+        if dropped:
+            ev["dropped_lines"] = dropped
+        return json.dumps(ev, sort_keys=True, default=str)
+
+    def flush(self) -> None:
+        """Serialize + append: one ``"o"`` line per span STILL open
+        that has not announced itself yet (so ``--check`` can prove
+        closure without the hot path paying per-open logging), one
+        ``"s"`` line per span finished since the last flush, one
+        metrics-delta line."""
+        if self.log_path is None:
+            return
+        with self._mu:
+            finished, self._buffer = self._buffer, []
+        opens = [sp for sp in self._open_snapshot()
+                 if not sp._o_logged and sp.t1 is None]
+        for sp in opens:
+            sp._o_logged = True
+        # serialize in SMALL chunks with an explicit GIL yield between
+        # them: a multi-ms json burst on the exporter thread would
+        # otherwise hold the GIL in whole switch-intervals and convoy
+        # the serving scheduler it exists to observe
+        lines = []
+        chunk = 128
+        for batch, to_ev in ((opens, Span.open_event),
+                             (finished, Span.to_event)):
+            for i in range(0, len(batch), chunk):
+                lines += [json.dumps(to_ev(sp), default=str)
+                          for sp in batch[i:i + chunk]]
+                time.sleep(0)
+        m = self._metrics_line()
+        if m is not None:
+            lines.append(m)
+        if not lines:
+            return
+        try:
+            with open(self.log_path, "a") as f:
+                f.write("\n".join(lines) + "\n")
+        except OSError:
+            pass
+
+    def maybe_flush(self) -> None:
+        if self.log_path is None or len(self._buffer) < _FLUSH_EVERY:
+            return
+        if self._flusher is not None:
+            # size-triggered flushes KICK the exporter thread rather
+            # than serializing inline: the hot path never pays for
+            # json.dumps (the <5% overhead budget)
+            self._kick.set()
+        else:
+            self.flush()
+
+    def _flush_loop(self) -> None:
+        while True:
+            self._kick.wait(self.flush_s)
+            self._kick.clear()
+            if self._stop_ev.is_set():
+                break
+            self.flush()
+        self.flush()
+
+    def close(self) -> None:
+        """Stop the exporter thread (if any) and write the tail."""
+        self._stop_ev.set()
+        self._kick.set()
+        if self._flusher is not None:
+            self._flusher.join(timeout=10)
+            self._flusher = None
+        self.flush()
+
+    # -------------------------------------------------------- snapshot
+    def finished(self) -> List[Span]:
+        """The in-memory ring of finished spans, oldest first.  The
+        hot path appends lock-free; deque.copy() is one C-level op
+        under the GIL, so a live scheduler can't interrupt the read."""
+        return list(self.ring.copy())

@@ -31,6 +31,7 @@ from ..executor import _GraphProgram
 from ..initializer import InitDesc
 from .. import initializer as _init_mod
 from .. import faults as _faults
+from .. import obs as _obs
 from .mesh import batch_sharding, replicated
 from .optim import make_update_fn
 
@@ -1157,7 +1158,9 @@ class Trainer:
                        rank=_process_index()):
             import os
             os._exit(137)
-        dev_batch = self._device_batch(batch)
+        corr = ("s%d" % self.num_update) if _obs.OBS else None
+        with _obs.span("train.h2d", corr=corr):
+            dev_batch = self._device_batch(batch)
         # fault injection (docs/how_to/resilience.md): poison the staged
         # batch so the backward materializes non-finite grads and the
         # sentinel's skip/abort path runs for real
@@ -1178,8 +1181,10 @@ class Trainer:
             # ZeRO-1: the standalone vote reads THIS update's incoming
             # state (same bits the fused check would have hashed) before
             # the step's all-gather can launder a divergent replica
-            self._external_vote()
-            self._integrity_after_check()
+            with _obs.span("train.integrity", corr=corr,
+                           attrs={"mode": self._integ_mode}):
+                self._external_vote()
+                self._integrity_after_check()
             check_now = False
         use_check = (self._integ is not None and self._integ_fused
                      and check_now)
@@ -1190,7 +1195,15 @@ class Trainer:
         if use_check:
             args += (self._integ,)
         args += (dev_batch, self._lr_cache[1], t_dev, key)
-        out = (self._step_check_fn if use_check else self._step_fn)(*args)
+        with _obs.span("train.dispatch", corr=corr):
+            out = (self._step_check_fn if use_check
+                   else self._step_fn)(*args)
+        if _obs.OBS:
+            # an armed run buys an honest dispatch-vs-device split: the
+            # sync span holds until the step's outputs materialize
+            # (off-mode keeps the normal async pipelining)
+            with _obs.span("train.sync", corr=corr):
+                jax.block_until_ready(out)
         self.params, self.aux, self.opt_state = out[0], out[1], out[2]
         i = 3
         if self._sent is not None:
@@ -1219,9 +1232,13 @@ class Trainer:
         if _faults.active("bitflip"):
             self._apply_bitflip_faults()
         if audit_now:
-            self._audit_check(saved, t_dev, key)
+            with _obs.span("train.integrity", corr=corr,
+                           attrs={"mode": "audit"}):
+                self._audit_check(saved, t_dev, key)
         if check_now:
-            self._integrity_after_check()
+            with _obs.span("train.integrity", corr=corr,
+                           attrs={"mode": self._integ_mode}):
+                self._integrity_after_check()
         return [NDArray(self._local_rows(o)) for o in outs]
 
     def _poison_batch(self, dev_batch: Dict) -> Dict:
@@ -1498,10 +1515,22 @@ class Trainer:
     @property
     def sentinel_skips(self) -> int:
         """Total sentinel-skipped steps (device counter; reading it
-        syncs, so poll it at epoch/bench granularity, not per step)."""
+        syncs, so poll it at epoch/bench granularity, not per step).
+        Every read refreshes this trainer's
+        ``train.trainer<N>.sentinel_skips`` registry gauge (instance
+        scoped — two trainers in one process must not clobber each
+        other), so an ``obs.snapshot()`` scrape sees the same number
+        the fit loop last saw."""
         if self._sent is None:
             return 0
-        return int(np.asarray(self._host_value(self._sent["skips"])))
+        skips = int(np.asarray(self._host_value(self._sent["skips"])))
+        gauge = getattr(self, "_obs_skips_gauge", None)
+        if gauge is None:
+            gauge = self._obs_skips_gauge = _obs.gauge(
+                "%s.sentinel_skips"
+                % _obs.REGISTRY.scope("train.trainer"))
+        gauge.set(skips)
+        return skips
 
     @property
     def loss_scale_value(self) -> float:

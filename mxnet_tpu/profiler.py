@@ -1,16 +1,28 @@
 """Profiler (reference ``python/mxnet/profiler.py`` + engine profiler
 ``src/engine/profiler.{h,cc}``).
 
-Two layers, matching the reference contract:
+Three layers, matching the reference contract and the unified
+telemetry story (``docs/how_to/observability.md``):
 
 * **Framework events** — executor forward/backward and imperative op
   dispatches are recorded with microsecond wall times and dumped as
   **Chrome tracing JSON** (the reference's ``Profiler::DumpProfile``
   format, ``profiler.cc:134-175``: one pid row per device, ``ph: B/E``
-  event pairs), so existing trace-viewing workflows keep working.
-* **Device profiling** — ``profiler_set_state('run')`` also starts the JAX
-  profiler (XPlane) when a trace dir is configured, capturing real TPU
-  timelines; this is the XLA-native layer the reference cannot see.
+  event pairs).  Each event carries the REAL recording thread (ident +
+  name, emitted as ``thread_name`` metadata rows), so concurrent
+  scheduler/uploader/decode events render on their own rows instead of
+  collapsing onto one ``tid == pid`` line.
+* **Runtime spans** — when the obs layer is recording
+  (``MXTPU_OBS=1``), its finished spans (serving request lifecycle,
+  training step segments, input-pipeline stages) merge into the same
+  dump as ``ph: X`` complete events on a ``host`` process row: one
+  Perfetto timeline from data loader to serving response.  Both
+  sources stamp ``time.perf_counter``-based microseconds, so they
+  align without translation.
+* **Device profiling** — ``profiler_set_state('run')`` also starts the
+  JAX profiler (XPlane) when a trace dir is configured, capturing real
+  TPU timelines; this is the XLA-native layer the reference cannot
+  see, loadable alongside the Chrome JSON in Perfetto.
 """
 from __future__ import annotations
 
@@ -59,35 +71,51 @@ def profiler_set_state(state="stop"):
         was = _STATE["running"]
         _STATE["running"] = (state == "run")
         if state == "run" and not was:
+            # clear in the SAME critical section that arms: an event
+            # recorded between the two would otherwise be wiped
             _STATE["events"] = []
-            if _STATE["jax_trace_dir"]:
-                import jax
-                jax.profiler.start_trace(_STATE["jax_trace_dir"])
-        elif state == "stop" and was:
-            if _STATE["jax_trace_dir"]:
-                import jax
-                jax.profiler.stop_trace()
+        trace_dir = _STATE["jax_trace_dir"]
+    # the jax profiler start/stop is a blocking call — keep it OUTSIDE
+    # the state lock (the concurrency lint's own rule); the transition
+    # decision was made atomically above
+    if state == "run" and not was:
+        if trace_dir:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+    elif state == "stop" and was:
+        if trace_dir:
+            import jax
+            jax.profiler.stop_trace()
 
 
 def set_jax_trace_dir(path):
     """Enable the XPlane device trace alongside the Chrome JSON dump."""
-    _STATE["jax_trace_dir"] = path
+    with _LOCK:
+        _STATE["jax_trace_dir"] = path
 
 
 def is_running():
-    return _STATE["running"]
+    with _LOCK:
+        return _STATE["running"]
 
 
 def mode():
-    return _STATE["mode"]
+    with _LOCK:
+        return _STATE["mode"]
 
 
 def record(name, start_us, end_us, device="tpu/0", category="operator"):
-    """Append one op event (called by the executor / dispatcher)."""
-    if not _STATE["running"]:
-        return
+    """Append one op event (called by the executor / dispatcher).  The
+    CALLING thread's ident + name ride along, so the dump can place
+    concurrent events on distinct, correctly-labelled rows."""
+    if not _STATE["running"]:   # tsan: ok — racy fast-path pre-check
+        return                  # (re-checked under _LOCK below)
+    t = threading.current_thread()
     with _LOCK:
-        _STATE["events"].append((name, start_us, end_us, device, category))
+        if not _STATE["running"]:
+            return
+        _STATE["events"].append((name, start_us, end_us, device,
+                                 category, t.ident or 0, t.name))
 
 
 class record_scope:
@@ -103,29 +131,72 @@ class record_scope:
         return self
 
     def __exit__(self, *exc):
-        if _STATE["running"]:
+        if is_running():
             record(self.name, self.start, time.perf_counter_ns() // 1000,
                    self.device, self.category)
 
 
+def _obs_spans():
+    """Finished obs spans (empty when the obs layer never recorded)."""
+    try:
+        from . import obs as _obs
+        return _obs.recorder().finished()
+    except Exception:                               # noqa: BLE001
+        return []
+
+
 def dump_profile():
     """Write Chrome tracing JSON (reference ``MXDumpProfile`` →
-    ``Profiler::DumpProfile`` format)."""
+    ``Profiler::DumpProfile`` format), merging the obs layer's spans
+    (if any) onto a ``host`` process row — load the result in Perfetto
+    for the single data-loader-to-serving-response timeline."""
     with _LOCK:
         events = list(_STATE["events"])
         fname = _STATE["filename"]
+    spans = _obs_spans()
     devices = sorted({e[3] for e in events})
+    if spans:
+        devices.append("host")
     pid_of = {d: i for i, d in enumerate(devices)}
     out = []
     for d, pid in pid_of.items():
         out.append({"ph": "M", "args": {"name": d}, "pid": pid,
                     "name": "process_name"})
-    for name, start_us, end_us, device, category in events:
+    # thread_name metadata: the shared (pid, ident, name)-keyed row
+    # allocator — ident reuse by the OS must not relabel a row (see
+    # obs.export.RowAllocator)
+    from .obs.export import RowAllocator
+    rows = RowAllocator(out)
+
+    def _row(pid, tid, tname):
+        return rows.row(pid, tid, tname)
+
+    for ev in events:
+        name, start_us, end_us, device, category = ev[:5]
+        # events recorded before the thread fields existed default to a
+        # per-device synthetic row (the old collapsed behavior)
+        tid, tname = (ev[5], ev[6]) if len(ev) > 6 \
+            else (pid_of[device], device)
         pid = pid_of[device]
+        tid = _row(pid, tid, tname)
         out.append({"name": name, "cat": category, "ph": "B",
-                    "ts": start_us, "pid": pid, "tid": pid})
+                    "ts": start_us, "pid": pid, "tid": tid})
         out.append({"name": name, "cat": category, "ph": "E",
-                    "ts": end_us, "pid": pid, "tid": pid})
+                    "ts": end_us, "pid": pid, "tid": tid})
+    if spans:
+        pid = pid_of["host"]
+        for sp in spans:
+            e = sp.to_event()
+            if e.get("t1") is None:
+                continue
+            args = {"corr": e.get("c")}
+            args.update(e.get("a") or {})
+            out.append({"name": e["n"], "cat": "obs", "ph": "X",
+                        "ts": round(e["t0"] * 1e6, 3),
+                        "dur": round((e["t1"] - e["t0"]) * 1e6, 3),
+                        "pid": pid,
+                        "tid": _row(pid, e["tid"], e.get("th") or "?"),
+                        "args": args})
     with open(fname, "w") as f:
         json.dump({"traceEvents": out}, f, indent=2)
     return fname

@@ -96,6 +96,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import _tsan
 from .. import faults as _faults
+from .. import obs as _obs
 from .compiled import CompiledForward, compiled_forward
 
 __all__ = ["ModelServer", "ServeFuture", "ServeTimeout", "ServeError",
@@ -132,7 +133,7 @@ class ServeFuture:
     """Completion handle for one submitted request."""
 
     __slots__ = ("_done", "_result", "_exc", "t_submit", "t_done",
-                 "_cancel_cb")
+                 "_cancel_cb", "_span")
 
     def __init__(self):
         self._done = threading.Event()
@@ -141,15 +142,24 @@ class ServeFuture:
         self.t_submit = time.perf_counter()
         self.t_done = None
         self._cancel_cb = None
+        self._span = None       # serve.request root (MXTPU_OBS=1 only)
 
     def _set_result(self, outs):
         self._result = outs
         self.t_done = time.perf_counter()
+        if self._span is not None:
+            # EVERY completion path funnels here, so the request's span
+            # tree closes exactly when its future does (the root sweeps
+            # any still-open child, e.g. a shed request's queue span)
+            self._span.finish(t=self.t_done)
         self._done.set()
 
     def _set_exception(self, exc):
         self._exc = exc
         self.t_done = time.perf_counter()
+        if self._span is not None:
+            self._span.attrs["error"] = type(exc).__name__
+            self._span.finish(t=self.t_done)
         self._done.set()
 
     def done(self) -> bool:
@@ -193,7 +203,7 @@ class ServeFuture:
 
 class _Request:
     __slots__ = ("rid", "inputs", "n", "future", "t_in", "deadline",
-                 "slow", "poisoned")
+                 "slow", "poisoned", "span", "queue_span")
 
     def __init__(self, rid, inputs, n, deadline):
         self.rid = rid
@@ -204,6 +214,8 @@ class _Request:
         self.deadline = None if deadline is None else self.t_in + deadline
         self.slow = _faults.hit("slow_request", request=rid)
         self.poisoned = _faults.hit("poison_request", request=rid)
+        self.span = None        # serve.request / serve.queue spans
+        self.queue_span = None  # (MXTPU_OBS=1 only; see submit())
 
 
 class _Model:
@@ -213,7 +225,7 @@ class _Model:
     __slots__ = ("name", "symbol", "cf", "params", "aux", "example_shapes",
                  "label_trailing", "input_dtypes", "queue", "pending",
                  "n_outputs", "breaker", "consec_failures", "opened_at",
-                 "batches", "sheds_since_batch")
+                 "batches", "sheds_since_batch", "lat_hist")
 
     def __init__(self, name, symbol, cf, params, aux, example_shapes,
                  label_trailing, input_dtypes, n_outputs):
@@ -333,17 +345,23 @@ class ModelServer:
         self._crashed = None        # scheduler supervision: the exception
         self._rr = 0                # round-robin rotation across models
         self._rid = 0
-        # counters (all mutated under _cond)
-        self._stats = {"requests": 0, "completed": 0, "failed": 0,
-                       "timeouts": 0, "batches": 0, "rows_real": 0,
-                       "rows_padded": 0,
-                       # overload / degradation accounting
-                       "rejected_overload": 0,      # queue_cap sheds
-                       "rejected_breaker": 0,       # breaker-open refusals
-                       "shed_deadline": 0,          # EWMA-predicted misses
-                       "expired_after_dispatch": 0,  # late results
-                       "cancelled": 0,              # ServeFuture.cancel
-                       "batch_failures": 0}         # whole-batch errors
+        # counters (all mutated under _cond; VALUES live in the metrics
+        # registry — obs.CounterDict keeps the `_stats[k] += 1` spelling
+        # and the dict(self._stats) snapshot shape while one
+        # obs.snapshot() per process scrapes every server's numbers,
+        # docs/how_to/observability.md)
+        self._obs_scope = _obs.REGISTRY.scope("serving.server")
+        self._stats = _obs.CounterDict(self._obs_scope, {
+            "requests": 0, "completed": 0, "failed": 0,
+            "timeouts": 0, "batches": 0, "rows_real": 0,
+            "rows_padded": 0,
+            # overload / degradation accounting
+            "rejected_overload": 0,      # queue_cap sheds
+            "rejected_breaker": 0,       # breaker-open refusals
+            "shed_deadline": 0,          # EWMA-predicted misses
+            "expired_after_dispatch": 0,  # late results
+            "cancelled": 0,              # ServeFuture.cancel
+            "batch_failures": 0})        # whole-batch errors
         self._occupancy: Dict[int, List[int]] = {}   # bucket -> [batches, rows]
 
     # ------------------------------------------------------------------
@@ -432,9 +450,15 @@ class ModelServer:
         cf = compiled_forward(
             symbol, list(example_shapes) + label_names,
             platform=self._platform(params))
-        self._models[name] = _Model(
+        m = _Model(
             name, symbol, cf, params, aux, example_shapes, label_trailing,
             dtypes, len(symbol.list_outputs()))
+        # per-model completed-request latency histogram (fixed buckets;
+        # stats() reports p50/p95/p99 beside the EWMA — a histogram
+        # survives the burst the EWMA smooths away)
+        m.lat_hist = _obs.REGISTRY.histogram(
+            "%s.%s.latency_ms" % (self._obs_scope, name))
+        self._models[name] = m
 
     def _platform(self, params):
         try:
@@ -637,6 +661,22 @@ class ModelServer:
             req = _Request(self._rid, arrs, n, remaining)
             req.future._cancel_cb = \
                 lambda _m=m, _r=req: self._cancel(_m, _r)
+            if _obs.OBS:
+                # the request's span tree roots HERE, while the request
+                # is still invisible to the scheduler (we hold _cond):
+                # root = the whole submit→complete lifecycle (closed by
+                # whichever path completes the future), queue = enqueue
+                # →dispatch (closed by _run_batch, or swept by the root
+                # on a shed/timeout).  Both backdated to t_in so the
+                # segments tile the measured end-to-end latency.
+                corr = "r%d" % req.rid
+                root = _obs.span("serve.request", corr=corr, parent=None,
+                                 attrs={"model": m.name, "rows": req.n})
+                root.t0 = req.t_in
+                qs = _obs.span("serve.queue", corr=corr, parent=root)
+                qs.t0 = req.t_in
+                req.span, req.queue_span = root, qs
+                req.future._span = root
             m.queue.append(req)
             m.pending += n
             self._stats["requests"] += 1
@@ -882,36 +922,64 @@ class ModelServer:
             # oversized fallback: exact shape — except on a mesh, where
             # the row-sharded batch dim must stay divisible
             padded = -(-total // self._data_axis) * self._data_axis
+        broot = None
+        if _obs.OBS:
+            # one span tree per dispatched batch, recorded on the
+            # scheduler thread; member requests are linked BOTH ways
+            # (the batch lists their correlation IDs, each request
+            # notes the batch's) so obs_report can bill the shared
+            # pad/dispatch/execute/slice segments to every member
+            t_take = time.perf_counter()
+            broot = _obs.span(
+                "serve.batch", corr="b%d" % batch[0].rid, parent=None,
+                attrs={"model": m.name, "rows": total, "padded": padded,
+                       "requests": ["r%d" % r.rid for r in batch]})
+            for r in batch:
+                if r.queue_span is not None:
+                    r.queue_span.finish(t=t_take)
+                if r.span is not None:
+                    r.span.attrs["batch"] = broot.corr
+        try:
+            self._assemble_and_run(m, batch, total, padded, broot)
+        finally:
+            if broot is not None:
+                broot.finish()
+
+    def _assemble_and_run(self, m: _Model, batch: List[_Request],
+                          total: int, padded: int, broot) -> None:
         # assemble the padded device batch; a slow request stalls only
         # its own cycle (the fault models a slow payload deserialize)
-        for r in batch:
-            if r.slow:
-                time.sleep(float(os.environ.get("MXTPU_SERVE_SLOW_S",
-                                                "0.05")))
-        feed = {}
-        for iname, trailing in m.example_shapes.items():
-            dt = m.input_dtypes[iname]
-            parts = []
+        with _obs.span("serve.pad", parent=broot):
             for r in batch:
-                a = r.inputs[iname]
-                # jnp.issubdtype, NOT np: bfloat16 is an ml_dtypes
-                # extension type that numpy does not class as floating
-                if r.poisoned and jnp.issubdtype(dt, jnp.floating):
-                    a = np.full(a.shape, np.nan, dt)
-                parts.append(a)
-            if padded > total:
-                parts.append(np.zeros((padded - total,) + trailing, dt))
-            feed[iname] = parts[0] if len(parts) == 1 \
-                else np.concatenate(parts, axis=0)
-        for lname, trailing in m.label_trailing.items():
-            feed[lname] = np.zeros((padded,) + trailing,
-                                   m.input_dtypes[lname])
-        if self.mesh is not None:
-            # the trainer's batch placement: dim 0 sharded along "data"
-            from ..parallel.mesh import batch_sharding
-            feed = {n: jax.device_put(
-                v, batch_sharding(self.mesh, np.ndim(v)))
-                for n, v in feed.items()}
+                if r.slow:
+                    time.sleep(float(os.environ.get("MXTPU_SERVE_SLOW_S",
+                                                    "0.05")))
+            feed = {}
+            for iname, trailing in m.example_shapes.items():
+                dt = m.input_dtypes[iname]
+                parts = []
+                for r in batch:
+                    a = r.inputs[iname]
+                    # jnp.issubdtype, NOT np: bfloat16 is an ml_dtypes
+                    # extension type that numpy does not class as floating
+                    if r.poisoned and jnp.issubdtype(dt, jnp.floating):
+                        a = np.full(a.shape, np.nan, dt)
+                    parts.append(a)
+                if padded > total:
+                    parts.append(np.zeros((padded - total,) + trailing,
+                                          dt))
+                feed[iname] = parts[0] if len(parts) == 1 \
+                    else np.concatenate(parts, axis=0)
+            for lname, trailing in m.label_trailing.items():
+                feed[lname] = np.zeros((padded,) + trailing,
+                                       m.input_dtypes[lname])
+            if self.mesh is not None:
+                # the trainer's batch placement: dim 0 sharded along
+                # "data"
+                from ..parallel.mesh import batch_sharding
+                feed = {n: jax.device_put(
+                    v, batch_sharding(self.mesh, np.ndim(v)))
+                    for n, v in feed.items()}
         t_run = time.perf_counter()
         try:
             # batch_error: the injectable whole-batch failure (a wedged
@@ -921,11 +989,30 @@ class ModelServer:
             if _faults.hit("batch_error", model=m.name):
                 raise ServeError("injected batch_error (model %r)"
                                  % m.name)
-            outs = m.cf.run(m.params, m.aux, feed)
-            outs_np = [np.asarray(o) for o in outs]
+            with _obs.span("serve.dispatch", parent=broot):
+                outs = m.cf.run(m.params, m.aux, feed)
+            with _obs.span("serve.execute", parent=broot):
+                # the device wait: np.asarray blocks until the
+                # executable's outputs materialize
+                outs_np = [np.asarray(o) for o in outs]
         except Exception as e:                        # noqa: BLE001
             self._batch_failed(m, batch, e)
             return
+        self._complete_batch(m, batch, total, padded, outs_np, t_run,
+                             broot)
+
+    def _complete_batch(self, m: _Model, batch: List[_Request],
+                        total: int, padded: int, outs_np, t_run,
+                        broot) -> None:
+        """Post-compute completion: batch bookkeeping, then slice the
+        outputs back per request and settle every future.  One span
+        (``serve.slice``) covers the whole phase, so the per-request
+        segments tile the measured end-to-end latency."""
+        with _obs.span("serve.slice", parent=broot):
+            self._settle_batch(m, batch, total, padded, outs_np, t_run)
+
+    def _settle_batch(self, m: _Model, batch: List[_Request],
+                      total: int, padded: int, outs_np, t_run) -> None:
         m.cf.record_latency(padded, time.perf_counter() - t_run)
         with self._cond:
             if _tsan.TSAN:
@@ -973,6 +1060,10 @@ class ModelServer:
                     "unaffected" % r.rid))
             else:
                 r.future._set_result(rows)
+                # completed-request latency into the per-model
+                # fixed-bucket histogram (stats() p50/p95/p99)
+                m.lat_hist.observe(
+                    (r.future.t_done - r.future.t_submit) * 1e3)
 
     def _batch_failed(self, m: _Model, batch: List[_Request], exc) -> None:
         """Whole-batch failure: fail the batch's futures, feed the
@@ -1041,13 +1132,18 @@ class ModelServer:
                     "batches": m.batches,
                 }
         # the latency EWMA lives under each CompiledForward's own lock;
-        # read it AFTER releasing _cond (never nest the two)
+        # read it AFTER releasing _cond (never nest the two) — same for
+        # the registry-backed latency histogram (its own mutex)
         for name, pm in per_model.items():
-            cf = self._models[name].cf
-            ewma = cf.expected_latency_s()
+            mm = self._models[name]
+            ewma = mm.cf.expected_latency_s()
             pm["ewma_batch_ms"] = None if ewma is None \
                 else round(ewma * 1e3, 3)
-            pm["latency_ms_by_bucket"] = cf.latency_ms_by_bucket()
+            pm["latency_ms_by_bucket"] = mm.cf.latency_ms_by_bucket()
+            # fixed-bucket percentiles over COMPLETED requests: the
+            # EWMA answers "what will the next batch cost", the
+            # histogram answers "what did clients actually see"
+            pm["latency_ms"] = mm.lat_hist.percentiles((50, 95, 99))
         s["occupancy"] = occ
         s["padding_frac"] = round(
             1.0 - s["rows_real"] / s["rows_padded"], 4) \
@@ -1061,6 +1157,9 @@ class ModelServer:
                        "breaker_cooldown_ms": round(
                            self.breaker_cooldown_s * 1e3, 1)}
         s["buckets"] = list(self.buckets)
+        # this server's namespace in the process-wide metrics registry
+        # (obs.snapshot() — the surface a fleet router scrapes)
+        s["obs_scope"] = self._obs_scope
         counts = [cf.counts() for cf, _ in self._cf_groups()]
         s["aot_compiles"] = sum(c["aot"] for c in counts)
         s["retraces"] = sum(c["retraces"] for c in counts)

@@ -1,0 +1,513 @@
+"""Unified runtime telemetry (``mxnet_tpu/obs/``): metrics registry,
+cross-layer spans, JSONL export, Chrome render, report tool —
+docs/how_to/observability.md.
+
+Covers the ISSUE-12 checklist: span-tree correctness for one serving
+request and one fit step (segment names, parent links, correlation-ID
+propagation across the scheduler thread), registry snapshot/merge,
+JSONL replay → Chrome JSON round-trip, off-mode type assertions (plain
+no-op sites), and the conftest thread-leak check passing with the
+exporter thread running.
+"""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
+from mxnet_tpu import obs                                 # noqa: E402
+
+
+# ----------------------------------------------------------------------
+# registry
+def test_registry_counter_gauge_snapshot():
+    reg = obs.Registry()
+    c = reg.counter("t.requests")
+    c.inc()
+    c.inc(4)
+    g = reg.gauge("t.depth")
+    g.set(7)
+    snap = reg.snapshot()
+    assert snap["counters"]["t.requests"] == 5
+    assert snap["gauges"]["t.depth"] == 7
+    # get-or-create returns the SAME metric; a kind clash is loud
+    assert reg.counter("t.requests") is c
+    with pytest.raises(mx.MXNetError):
+        reg.gauge("t.requests")
+
+
+def test_registry_scope_unique():
+    reg = obs.Registry()
+    assert reg.scope("io.upload") == "io.upload0"
+    assert reg.scope("io.upload") == "io.upload1"
+    assert reg.scope("serving.server") == "serving.server0"
+
+
+def test_histogram_fixed_bucket_percentiles():
+    reg = obs.Registry()
+    h = reg.histogram("t.lat", buckets=(1.0, 2.0, 4.0, 8.0))
+    assert h.percentile(50) is None
+    for v in (0.5, 1.5, 1.5, 3.0, 9.0):
+        h.observe(v)
+    p = h.percentiles((50, 95, 99))
+    assert p["count"] == 5
+    # median lands in the (1, 2] bucket
+    assert 1.0 <= p["p50"] <= 2.0
+    # the tail interpolates toward the observed max (overflow bucket)
+    assert 4.0 <= p["p99"] <= 9.0
+    snap = h.snapshot()
+    assert snap["counts"] == [1, 2, 1, 0, 1]
+    assert snap["min"] == 0.5 and snap["max"] == 9.0
+
+
+def test_registry_merge_sums_counters_and_hists():
+    reg = obs.Registry()
+    reg.counter("n").inc(3)
+    h = reg.histogram("h", buckets=(1.0, 10.0))
+    h.observe(0.5)
+    h.observe(5.0)
+    a = reg.snapshot()
+    m = obs.Registry.merge(a, a)
+    assert m["counters"]["n"] == 6
+    assert m["histograms"]["h"]["count"] == 4
+    assert m["histograms"]["h"]["counts"] == [2, 2, 0]
+    assert m["histograms"]["h"]["min"] == 0.5
+    # gauges: last snapshot wins
+    b = {"counters": {}, "gauges": {"g": 9}, "histograms": {}}
+    assert obs.Registry.merge(a, b)["gauges"]["g"] == 9
+    # mismatched ladders refuse to merge
+    bad = {"counters": {}, "gauges": {},
+           "histograms": {"h": {"buckets": [2.0], "counts": [0, 0],
+                                "count": 0, "sum": 0.0,
+                                "min": None, "max": None}}}
+    with pytest.raises(ValueError):
+        obs.Registry.merge(a, bad)
+
+
+def test_counter_dict_preserves_dict_shape():
+    reg = obs.Registry()
+    cd = obs.CounterDict("t.srv", {"requests": 0, "failed": 0},
+                         registry=reg)
+    cd["requests"] += 1
+    cd["requests"] += 1
+    cd["failed"] += 1
+    assert dict(cd) == {"requests": 2, "failed": 1}
+    assert reg.snapshot()["counters"]["t.srv.requests"] == 2
+    with pytest.raises(TypeError):
+        del cd["requests"]
+
+
+# ----------------------------------------------------------------------
+# spans: core mechanics
+def test_off_mode_sites_are_plain_noops():
+    # force OFF for the scope whatever the ambient env (the TSAN sweep
+    # runs this suite under MXTPU_OBS=1), restoring after
+    was = obs.enabled()
+    obs.disable()
+    try:
+        sp = obs.span("anything", corr="x", attrs={"k": 1})
+        assert sp is obs.NULL_SPAN             # the shared singleton
+        assert obs.span("other") is sp         # no allocation per site
+        with sp:
+            pass
+        sp.finish()                            # all inert
+        assert obs.current_span() is None
+        # a serving future carries no span object when off
+        from mxnet_tpu.serving.server import ServeFuture
+        assert ServeFuture()._span is None
+    finally:
+        if was:
+            obs.enable()
+
+
+def test_span_nesting_corr_inheritance_and_cross_thread_parent():
+    with obs.scoped() as rec:
+        with obs.span("root", corr="r9", attrs={"model": "m"}) as root:
+            with obs.span("child"):
+                cur = obs.current_span()
+                assert cur.name == "child"
+                assert cur.corr == "r9"            # inherited
+                assert cur.parent == root.sid
+        # cross-thread: explicit parent hand-off
+        out = {}
+
+        def worker():
+            sp = obs.span("seg", parent=root)
+            out["corr"] = sp.corr
+            out["thread"] = sp.thread
+            sp.finish()
+
+        t = threading.Thread(target=worker, name="mxtpu-test-w",
+                             daemon=True)
+        t.start()
+        t.join()
+        assert out["corr"] == "r9"
+        assert out["thread"] == "mxtpu-test-w"
+        spans = {s.name: s for s in rec.finished()}
+        assert spans["seg"].parent == root.sid
+        was_inside = obs.enabled()
+    # scoped() restored the AMBIENT flag (off normally, on under the
+    # MXTPU_OBS=1 sweep) and the global recorder
+    assert was_inside
+    assert obs.recorder() is not rec
+
+
+def test_parent_finish_sweeps_open_children_idempotently():
+    with obs.scoped() as rec:
+        root = obs.span("root", corr="r1", parent=None)
+        kid = obs.span("kid", parent=root)
+        root.finish()
+        assert kid.t1 is not None and kid.t1 == root.t1
+        kid.finish()                       # second finish: no-op
+        assert len([s for s in rec.finished() if s.name == "kid"]) == 1
+        assert rec.open_spans() == []
+
+
+# ----------------------------------------------------------------------
+# serving span tree
+def _mlp_model(seed=0):
+    rng = np.random.RandomState(seed)
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=8, name="fc1")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    args = {"fc1_weight": mx.nd.array((rng.randn(8, 4) / 4).astype("f")),
+            "fc1_bias": mx.nd.array(np.zeros(8, "f"))}
+    return sym, args
+
+
+def test_serving_request_span_tree_and_scheduler_corr():
+    from mxnet_tpu import serving
+    sym, args = _mlp_model()
+    with obs.scoped() as rec:
+        server = serving.ModelServer(buckets=[1, 4], max_wait_us=500)
+        server.add_model("m", sym, args, {}, input_shapes={"data": (4,)})
+        with server:
+            f = server.submit(data=np.ones((2, 4), "f"))
+            f.result(timeout=30)
+        spans = rec.finished()
+    by = {}
+    for s in spans:
+        by.setdefault(s.name, []).append(s)
+    req = by["serve.request"][0]
+    queue = by["serve.queue"][0]
+    batch = by["serve.batch"][0]
+    # correlation ID propagation: request spans record on the caller
+    # thread, batch segments on the scheduler thread, joined by corr
+    assert req.corr.startswith("r")
+    assert queue.corr == req.corr and queue.parent == req.sid
+    assert req.corr in batch.attrs["requests"]
+    assert req.attrs["batch"] == batch.corr
+    assert batch.thread == "mxtpu-serve-sched"
+    assert req.thread == "MainThread"
+    segs = {s.name: s for s in spans if s.parent == batch.sid}
+    assert sorted(segs) == ["serve.dispatch", "serve.execute",
+                            "serve.pad", "serve.slice"]
+    for s in segs.values():
+        assert s.corr == batch.corr
+    # segments tile the end-to-end latency (the acceptance bound is
+    # checked on the mean over a larger run in test_acceptance below)
+    assert req.t1 is not None and req.duration_s > 0
+
+
+def test_serving_failed_request_closes_its_tree():
+    from mxnet_tpu import serving
+    sym, args = _mlp_model()
+    with obs.scoped() as rec:
+        # a long coalescing window parks the request in queue; the
+        # explicit cancel exercises a FAILURE completion path — the
+        # span tree must close through it (root sweeps the open queue
+        # child), not leak
+        server = serving.ModelServer(buckets=[1, 4],
+                                     max_wait_us=10_000_000, cap=64)
+        server.add_model("m", sym, args, {}, input_shapes={"data": (4,)})
+        with server:
+            f = server.submit(data=np.ones((1, 4), "f"))
+            assert f.cancel()
+            with pytest.raises(serving.ServeCancelled):
+                f.result(timeout=30)
+        assert rec.open_spans() == []
+        reqs = [s for s in rec.finished() if s.name == "serve.request"]
+        assert reqs and reqs[0].attrs.get("error") == "ServeCancelled"
+        queues = [s for s in rec.finished() if s.name == "serve.queue"]
+        assert queues and queues[0].t1 == reqs[0].t1   # swept by root
+
+
+def test_server_stats_registry_backed_and_latency_hist():
+    from mxnet_tpu import serving
+    sym, args = _mlp_model()
+    server = serving.ModelServer(buckets=[1, 4], max_wait_us=300)
+    server.add_model("m", sym, args, {}, input_shapes={"data": (4,)})
+    with server:
+        for _ in range(5):
+            server.predict(data=np.ones((1, 4), "f"))
+        st = server.stats()
+    # dict shape preserved (the pre-registry keys, same types)
+    assert st["requests"] == 5 and st["completed"] == 5
+    assert isinstance(st["requests"], int)
+    # the same numbers are scrapable process-wide via the registry
+    scope = st["obs_scope"]
+    snap = obs.snapshot()
+    assert snap["counters"]["%s.requests" % scope] == 5
+    # per-model fixed-bucket latency percentiles beside the EWMA
+    lat = st["per_model"]["m"]["latency_ms"]
+    assert lat["count"] == 5
+    assert lat["p50"] is not None and lat["p50"] <= lat["p99"]
+    hname = "%s.m.latency_ms" % scope
+    assert snap["histograms"][hname]["count"] == 5
+
+
+def test_upload_iter_stats_registry_backed():
+    from mxnet_tpu.io import DeviceUploadIter, NDArrayIter
+    X = np.random.RandomState(0).randn(16, 3).astype("f")
+    it = DeviceUploadIter(NDArrayIter(X, None, batch_size=4), depth=2)
+    n = 0
+    for _ in it:
+        n += 1
+    assert n == 4
+    st = it.stats()
+    assert st["batches_staged"] == 4
+    assert it.batches_staged == 4          # back-compat property
+    scope = it._obs_scope
+    snap = obs.snapshot()
+    assert snap["counters"]["%s.batches_staged" % scope] == 4
+    assert snap["counters"]["%s.next_calls" % scope] == 5
+
+
+# ----------------------------------------------------------------------
+# fit / training step tree
+def _fit_module(tmp_path=None, epochs=2):
+    rng = np.random.RandomState(0)
+    X = rng.randn(32, 10).astype("f")
+    Y = rng.randint(0, 2, 32).astype("f")
+    it = mx.io.NDArrayIter(X, Y, batch_size=8, label_name="softmax_label")
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=4, name="fc1")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    mod = mx.mod.Module(symbol=sym, context=mx.cpu())
+    kw = {}
+    if tmp_path is not None:
+        kw = {"checkpoint": str(tmp_path / "ck"), "checkpoint_period": 1}
+    mod.fit(it, num_epoch=epochs, **kw)
+    return mod
+
+
+def test_fit_step_span_tree(monkeypatch, tmp_path):
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "always")
+    with obs.scoped() as rec:
+        _fit_module(tmp_path)
+        assert rec.open_spans() == []
+        spans = rec.finished()
+    by = {}
+    for s in spans:
+        by.setdefault(s.name, []).append(s)
+    steps = sorted(by["train.step"], key=lambda s: s.sid)
+    assert len(steps) == 8                      # 2 epochs x 4 batches
+    assert [s.corr for s in steps] == ["s%d" % i for i in range(1, 9)]
+    first = steps[0]
+    kids = sorted({s.name for s in spans if s.parent == first.sid})
+    # h2d/dispatch/sync recorded INSIDE Trainer.step nest under fit's
+    # root via the thread-local stack, sharing its correlation ID
+    assert kids == ["train.dispatch", "train.h2d", "train.sync"]
+    assert all(s.corr == first.corr for s in spans
+               if s.parent == first.sid)
+    fetches = [s for s in by["fit.fetch"] if s.corr == first.corr]
+    assert fetches, "fit.fetch missing for the first step"
+    # epoch-level phases
+    cks = by.get("fit.checkpoint") or []
+    assert [c.corr for c in cks] == ["e1", "e2"]
+
+
+def test_sentinel_gauge_updates_on_read(monkeypatch):
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "always")
+    monkeypatch.setenv("MXTPU_SENTINEL", "skip")
+    mod = _fit_module(epochs=1)
+    tr = mod._trainer
+    if tr is None or tr._sent is None:
+        pytest.skip("no fused sentinel trainer in this configuration")
+    skips = tr.sentinel_skips
+    gauges = obs.snapshot()["gauges"]
+    mine = [k for k in gauges
+            if k.startswith("train.trainer") and
+            k.endswith(".sentinel_skips")]
+    assert mine and gauges[tr._obs_skips_gauge.name] == skips
+
+
+# ----------------------------------------------------------------------
+# exporter / JSONL / Chrome round-trip
+def test_jsonl_replay_chrome_roundtrip(tmp_path):
+    log = str(tmp_path / "obs.jsonl")
+    with obs.scoped(log_path=log, flush_s=0) as rec:
+        with obs.span("alpha", corr="r1", attrs={"rows": 2}):
+            time.sleep(0.001)
+        obs.span("beta", corr="r1", parent=None).finish()
+        rec.flush()
+    events = obs.parse_log(log)
+    closes = [e for e in events if e["k"] == "s"]
+    assert {e["n"] for e in closes} == {"alpha", "beta"}
+    alpha = next(e for e in closes if e["n"] == "alpha")
+    assert alpha["a"] == {"rows": 2}
+    assert alpha["t1"] > alpha["t0"]
+    assert alpha["th"] == "MainThread" and alpha["tid"]
+    # metrics lines carry counter deltas + histograms
+    assert any(e["k"] == "m" for e in events)
+    # chrome render: named thread rows + X events with durations
+    trace = obs.chrome_trace(closes)
+    rows = [e for e in trace["traceEvents"]
+            if e.get("name") == "thread_name"]
+    assert [r["args"]["name"] for r in rows] == ["MainThread"]
+    xs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"alpha", "beta"}
+    assert all(e["dur"] >= 0 for e in xs)
+    json.dumps(trace)                       # serializable as a whole
+
+
+def test_torn_log_lines_skipped(tmp_path):
+    log = str(tmp_path / "obs.jsonl")
+    with obs.scoped(log_path=log, flush_s=0) as rec:
+        obs.span("ok", parent=None).finish()
+        rec.flush()
+    with open(log, "a") as f:
+        f.write('{"k": "s", "truncated...\n')
+    events = obs.parse_log(log)
+    assert [e["n"] for e in events if e["k"] == "s"] == ["ok"]
+
+
+def test_exporter_thread_runs_and_stops(tmp_path):
+    """The mxtpu-obs-flush exporter thread writes periodically and is
+    stopped by scope exit — the conftest autouse thread-leak check is
+    the real assertion here (it fails this test if the thread
+    survives)."""
+    log = str(tmp_path / "obs.jsonl")
+    with obs.scoped(log_path=log, flush_s=0.05) as rec:
+        names = [t.name for t in threading.enumerate()]
+        assert "mxtpu-obs-flush" in names
+        obs.span("periodic", parent=None).finish()
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if os.path.exists(log) and any(
+                    e["k"] == "s" for e in obs.parse_log(log)):
+                break
+            time.sleep(0.02)
+        else:
+            raise AssertionError("periodic flush never wrote the span")
+    assert "mxtpu-obs-flush" not in [t.name for t in
+                                     threading.enumerate()]
+
+
+def test_unclosed_span_detected_by_report(tmp_path):
+    from tools.obs_report import main as report_main
+    log = str(tmp_path / "obs.jsonl")
+    with obs.scoped(log_path=log, flush_s=0) as rec:
+        obs.span("leaky", parent=None)      # never finished
+        obs.span("fine", parent=None).finish()
+        rec.flush()                         # "o" emitted for the leak
+    assert report_main([log, "--check"]) == 1
+    # a clean log passes
+    log2 = str(tmp_path / "obs2.jsonl")
+    with obs.scoped(log_path=log2, flush_s=0) as rec:
+        obs.span("fine", parent=None).finish()
+        rec.flush()
+    assert report_main([log2, "--check"]) == 0
+
+
+# ----------------------------------------------------------------------
+# the acceptance drill: one MXTPU_OBS=1 serving run + one fit run into
+# a single JSONL log; the report reconstructs complete trees with
+# segments summing to e2e within 5%, and the Chrome export has distinct
+# named thread rows
+def test_acceptance_single_log_serving_and_fit(tmp_path, monkeypatch):
+    from mxnet_tpu import serving
+    from tools import obs_report
+
+    monkeypatch.setenv("MXTPU_MODULE_FUSED", "always")
+    # the 5% latency-accounting bound is the acceptance gate for a
+    # normal MXTPU_OBS=1 run.  Under the MXTPU_TSAN=1 sweep every lock
+    # acquisition pays the sanitizer's lockset bookkeeping, inflating
+    # the unattributed gaps BETWEEN segments (queue->pad, settle->
+    # future-set) by the instrumentation's own cost — widen the
+    # tolerance there; the dedicated obs CI stage keeps the 5% gate.
+    from mxnet_tpu import _tsan
+    tol = 15.0 if _tsan.enabled() else 5.0
+    log = str(tmp_path / "obs.jsonl")
+    sym, args = _mlp_model()
+    with obs.scoped(log_path=log, flush_s=0.2) as rec:
+        server = serving.ModelServer(buckets=[1, 4, 8],
+                                     max_wait_us=500)
+        server.add_model("m", sym, args, {}, input_shapes={"data": (4,)})
+        with server:
+            futs = [server.submit(data=np.ones((1, 4), "f") * i)
+                    for i in range(16)]
+            for f in futs:
+                f.result(timeout=30)
+        _fit_module(tmp_path)
+        assert rec.open_spans() == []
+    rep, spans = obs_report.report([log], tol_pct=tol)
+    assert rep["unclosed"] == []
+    srv = rep["serving"]
+    assert srv["requests"] == 16 and srv["complete"] == 16
+    # every request has the full segment set
+    for row in srv["per_request"]:
+        assert sorted(row["segments_ms"]) == ["dispatch", "execute",
+                                              "pad", "queue", "slice"]
+    assert srv["sum_within_tol"], \
+        "segment sums off by %s%% median (mean %s%%; rows: %s)" % (
+            srv["median_residual_pct"], srv["mean_residual_pct"],
+            [r["residual_pct"] for r in srv["per_request"][:4]])
+    trn = rep["training"]
+    assert trn["steps"] >= 8
+    with_dispatch = [r for r in trn["per_step"]
+                     if "train.dispatch" in r["segments_ms"]]
+    assert len(with_dispatch) == 8
+    for row in with_dispatch:
+        assert "train.h2d" in row["segments_ms"]
+        assert "train.sync" in row["segments_ms"]
+        assert "fit.fetch" in row["segments_ms"]
+    # chrome export: the loader/scheduler/main rows are distinct
+    out = str(tmp_path / "trace.json")
+    assert obs_report.main([log, "--chrome", out, "--check",
+                            "--tol", str(tol)]) == 0
+    with open(out) as f:
+        trace = json.load(f)
+    rows = {e["args"]["name"] for e in trace["traceEvents"]
+            if e.get("name") == "thread_name"}
+    assert "MainThread" in rows and "mxtpu-serve-sched" in rows
+    assert len(rows) >= 3       # + uploader (or other mxtpu-* workers)
+
+
+def test_profiler_dump_real_tids_and_obs_merge(tmp_path):
+    """Satellite: profiler.py records the real thread id + name (no
+    more tid==pid row collapse) and merges obs spans into one dump."""
+    from mxnet_tpu import profiler
+    fname = str(tmp_path / "profile.json")
+    profiler.profiler_set_config(mode="all", filename=fname)
+    profiler.profiler_set_state("run")
+    res = {}
+
+    def bg():
+        with profiler.record_scope("bg_op", device="cpu/0"):
+            res["tid"] = threading.get_ident()
+
+    t = threading.Thread(target=bg, name="mxtpu-test-bg", daemon=True)
+    with profiler.record_scope("main_op", device="cpu/0"):
+        t.start()
+        t.join()
+    with obs.scoped():
+        obs.span("obs_seg", corr="r1", parent=None).finish()
+        profiler.profiler_set_state("stop")
+        out = profiler.dump_profile()
+    with open(out) as f:
+        trace = json.load(f)
+    evs = trace["traceEvents"]
+    tids = {e["name"]: e["tid"] for e in evs if e.get("ph") == "B"}
+    assert tids["main_op"] != tids["bg_op"]
+    rows = {e["args"]["name"] for e in evs
+            if e.get("name") == "thread_name"}
+    assert {"MainThread", "mxtpu-test-bg"} <= rows
+    assert any(e.get("ph") == "X" and e["name"] == "obs_seg"
+               for e in evs)

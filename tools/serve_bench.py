@@ -92,6 +92,32 @@ def build_model(network="mlp", seed=0):
             "fc3_bias": mx.nd.array(np.zeros(16, "f")),
         }
         return sym, args, {}, example
+    if network == "mlp-wide":
+        # the obs-overhead probe's workload: same shape as "mlp" but
+        # wide enough that a batch's execute time is serving-realistic
+        # (hundreds of us on the CPU tier) — a model whose whole batch
+        # costs less than a Python function call would measure the
+        # interpreter, not the telemetry
+        data = mx.sym.Variable("data")
+        net = mx.symbol.FullyConnected(data, num_hidden=512, name="fc1")
+        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.FullyConnected(net, num_hidden=512, name="fc2")
+        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.FullyConnected(net, num_hidden=16, name="fc3")
+        sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+        example = (64,)
+        args = {
+            "fc1_weight": mx.nd.array(
+                (rng.randn(512, 64) / 8).astype("f")),
+            "fc1_bias": mx.nd.array(np.zeros(512, "f")),
+            "fc2_weight": mx.nd.array(
+                (rng.randn(512, 512) / 23).astype("f")),
+            "fc2_bias": mx.nd.array(np.zeros(512, "f")),
+            "fc3_weight": mx.nd.array(
+                (rng.randn(16, 512) / 23).astype("f")),
+            "fc3_bias": mx.nd.array(np.zeros(16, "f")),
+        }
+        return sym, args, {}, example
     if network == "resnet-50":
         from mxnet_tpu import models
         sym = models.get_symbol("resnet-50", num_classes=1000,
@@ -399,10 +425,100 @@ def serving_probe(network="mlp", quick=True, buckets=None,
         "loads": loads,
         "occupancy": st["occupancy"],
         "padding_frac": st["padding_frac"],
+        # fixed-bucket percentiles over every COMPLETED request of the
+        # sweep (the registry-backed histogram behind stats(); the
+        # p50/p99 above are exact per-load sorts, this is what a
+        # steady-state scrape of the server itself reports)
+        "latency_hist_ms": {name: pm["latency_ms"]
+                            for name, pm in st["per_model"].items()},
         "batched_ge_single": all(
             r["achieved_rps"] >= min(r["offered_rps"], cap) * 0.95
             for r in loads),
         "fault_demo": demo,
+    }
+
+
+# ----------------------------------------------------------------------
+def obs_overhead_probe(network="mlp-wide", pairs=3, n=200, buckets=None,
+                       seed=0):
+    """Measure the cost of ``MXTPU_OBS=1`` span recording + JSONL
+    export on the serving path (``docs/how_to/observability.md``).
+
+    The GATED number (``obs_overhead_pct``, bench.py asserts < 5%)
+    compares alternating OFF/ON **open-loop Poisson sweeps at half the
+    measured saturation throughput** over one warmed server — the
+    serving sweep's own arrival model at a load the server holds, where
+    telemetry must fit inside the batching slack without stretching the
+    completion wall.  A secondary, informational number
+    (``obs_overhead_saturated_pct``) compares closed-loop saturation
+    blasts — the worst case, where every telemetry microsecond competes
+    with the scheduler's own Python on a fully-loaded host; it is
+    reported, not gated, because on a 1-2 core CI box its baseline
+    varies more run-to-run than the effect being measured.  Alternating
+    pairs, min-of-2 windows per phase, and the median ratio are the
+    anti-noise measures the integrity probe established."""
+    import tempfile
+
+    from mxnet_tpu import obs, serving
+
+    sym, args, aux, example = build_model(network, seed)
+    rng = np.random.RandomState(seed + 1)
+    # 4-row requests: the serving sweep's upper row-mix — per-request
+    # compute at the batched design point, not the 1-row degenerate
+    payloads = [rng.randn(4, *example).astype("f") for _ in range(n)]
+
+    server = serving.ModelServer(buckets=buckets, max_wait_us=200)
+    server.add_model("m", sym, args, aux, input_shapes={"data": example})
+
+    def blast():
+        t0 = time.perf_counter()
+        futs = [server.submit(data=p) for p in payloads]
+        for f in futs:
+            f.result(timeout=60)
+        return time.perf_counter() - t0
+
+    def sweep(rate_rps, seed_):
+        t0 = time.perf_counter()
+        futs, _, _, _, _ = _open_loop_submit(server, payloads, rate_rps,
+                                             seed=seed_)
+        for f in futs:
+            f.result(timeout=60)
+        return time.perf_counter() - t0
+
+    sat_ratios, sweep_ratios, samples = [], [], []
+    with server, tempfile.TemporaryDirectory() as d:
+        blast()                                    # warm the off path
+        with obs.scoped(log_path=os.path.join(d, "warm.jsonl"),
+                        flush_s=0.2):
+            blast()                                # warm the on path
+        cap_rps = n / min(blast(), blast())        # saturation estimate
+        rate = cap_rps / 2.0
+        for i in range(pairs):
+            # min-of-2 per phase: the min filters the scheduler noise a
+            # shared CI host injects into any single window
+            sw_off = min(sweep(rate, seed + i), sweep(rate, seed + i))
+            bl_off = min(blast(), blast())
+            log = os.path.join(d, "obs_%d.jsonl" % i)
+            # flush_s matches the production arrangement: the exporter
+            # thread serializes off the hot path, concurrently
+            with obs.scoped(log_path=log, flush_s=0.2):
+                sw_on = min(sweep(rate, seed + i), sweep(rate, seed + i))
+                bl_on = min(blast(), blast())
+            sweep_ratios.append(sw_on / sw_off)
+            sat_ratios.append(bl_on / bl_off)
+            samples.append({"sweep_off_s": round(sw_off, 4),
+                            "sweep_on_s": round(sw_on, 4),
+                            "blast_off_s": round(bl_off, 4),
+                            "blast_on_s": round(bl_on, 4)})
+    med = float(np.median(sweep_ratios))
+    sat = float(np.median(sat_ratios))
+    return {
+        "network": network,
+        "requests_per_window": n,
+        "sweep_rate_rps": round(rate, 1),
+        "pairs": samples,
+        "obs_overhead_pct": round((med - 1.0) * 100.0, 2),
+        "obs_overhead_saturated_pct": round((sat - 1.0) * 100.0, 2),
     }
 
 
