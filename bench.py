@@ -417,7 +417,7 @@ def _zero_ab(mx, n_steps=4):
     return {"n_devices": len(devices), "steps": n_steps, "rows": rows}
 
 
-def _elastic_drill(timeout=420):
+def _elastic_drill(timeout=420, cache_dir=None):
     """2-process CPU elastic recovery drill (docs/how_to/multi_host.md
     "Elastic training"): the launcher's ``--local-elastic`` runs
     ``tests/nightly/elastic_train.py`` with a ``host_dead`` fault on
@@ -437,6 +437,13 @@ def _elastic_drill(timeout=420):
     env.pop("MXTPU_COORDINATOR", None)
     env.pop("MXTPU_ELASTIC_DIR", None)
     env.pop("MXTPU_HEARTBEAT_DIR", None)
+    if cache_dir is not None:
+        # persisted compiled-program cache: the relaunched survivor
+        # loads its step executable instead of recompiling — recovery
+        # drops to load-not-compile (docs/how_to/compiled_programs.md)
+        env["MXTPU_PROGRAM_CACHE"] = cache_dir
+    else:
+        env.pop("MXTPU_PROGRAM_CACHE", None)
     try:
         res = subprocess.run(
             [sys.executable, os.path.join(root, "tools", "launch.py"),
@@ -454,6 +461,62 @@ def _elastic_drill(timeout=420):
         return round(float(m.group(1)), 2)
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _program_cache_probe(timeout=240):
+    """Cold-vs-warm restart cost of the persisted compiled-program
+    cache (docs/how_to/compiled_programs.md): run
+    ``tests/nightly/program_warm.py`` — trainer bind+init+3 steps,
+    ``Predictor.from_checkpoint``, a 2-bucket ``ModelServer.start()`` —
+    twice in fresh processes sharing one ``MXTPU_PROGRAM_CACHE`` dir.
+    ``cold_start_compile_s`` sums the cold run's per-path walls (full
+    trace+compile); ``warm_restart_s`` the warm run's (deserialize
+    only — the drill itself FAILS unless the warm run compiles zero
+    programs and reproduces the cold fingerprints)."""
+    import shutil
+    import subprocess
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    cdir = tempfile.mkdtemp(prefix="mxtpu-progcache-bench-")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["MXTPU_PROGRAM_CACHE"] = cdir
+    env.pop("XLA_FLAGS", None)
+    script = os.path.join(root, "tests", "nightly", "program_warm.py")
+
+    def run(expect):
+        res = subprocess.run(
+            [sys.executable, script, "--expect", expect],
+            env=env, cwd=root, capture_output=True, text=True,
+            timeout=timeout)
+        if res.returncode != 0:
+            raise RuntimeError("program-warm drill (%s) failed: %s"
+                               % (expect,
+                                  (res.stdout + res.stderr)[-800:]))
+        line = [ln for ln in res.stdout.splitlines()
+                if ln.startswith("PROGRAM_WARM ")][-1]
+        return json.loads(line[len("PROGRAM_WARM "):])
+
+    try:
+        cold = run("cold")
+        warm = run("warm")
+    finally:
+        shutil.rmtree(cdir, ignore_errors=True)
+    if warm["fingerprints"] != cold["fingerprints"]:
+        raise RuntimeError(
+            "program-cache drill: warm fingerprints %s diverge from "
+            "cold %s — a loaded executable computed something "
+            "different" % (warm["fingerprints"], cold["fingerprints"]))
+    return {
+        "cold_start_compile_s": round(sum(cold["wall"].values()), 3),
+        "warm_restart_s": round(sum(warm["wall"].values()), 3),
+        "cold_wall": cold["wall"],
+        "warm_wall": warm["wall"],
+        "compiles_cold": cold["compiles"],
+        "compiles_warm": warm["compiles"],
+        "loads_warm": warm["loads"],
+        "server_warmups_loaded": warm["warmup_loaded"],
+    }
 
 
 def _integrity_overhead_probe(workload_step_s, period=100, steps=200,
@@ -882,8 +945,37 @@ def main():
     if os.environ.get("MXTPU_BENCH_ELASTIC", "1") != "0":
         try:
             line["elastic_recovery_s"] = _elastic_drill()
+            # warm-restart variant (docs/how_to/compiled_programs.md):
+            # the same kill-shrink-resume against a persisted program
+            # cache.  One drill populates the cache (the 2-world AND
+            # the shrunk 1-world programs persist), the next measures
+            # recovery as pure load-not-compile.
+            import shutil
+            import tempfile
+            cdir = tempfile.mkdtemp(prefix="mxtpu-progcache-")
+            try:
+                _elastic_drill(cache_dir=cdir)        # populate
+                line["elastic_recovery_warm_s"] = \
+                    _elastic_drill(cache_dir=cdir)    # measure warm
+            finally:
+                shutil.rmtree(cdir, ignore_errors=True)
         except Exception as e:                      # noqa: BLE001
             line["elastic_error"] = str(e)
+
+    # --- persisted compiled-program cache (docs/how_to/
+    # compiled_programs.md): the warm-restart drill — trainer bind+init+
+    # step, Predictor from_checkpoint, ModelServer 2-bucket start — run
+    # twice in fresh processes against one cache dir.  cold = full
+    # trace+compile, warm = deserialize only (the drill ASSERTS the
+    # warm run compiles zero programs).  MXTPU_BENCH_PROGRAM=0 skips.
+    if os.environ.get("MXTPU_BENCH_PROGRAM", "1") != "0":
+        try:
+            probe = _program_cache_probe()
+            line["cold_start_compile_s"] = probe["cold_start_compile_s"]
+            line["warm_restart_s"] = probe["warm_restart_s"]
+            line["program_cache"] = probe
+        except Exception as e:                      # noqa: BLE001
+            line["program_cache_error"] = str(e)
 
     # --- silent-data-corruption defense (docs/how_to/resilience.md
     # "Silent data corruption"): rebuild the module with the in-step

@@ -132,6 +132,24 @@ stage "graph lint gate (trace-time, no device execution)"
 # prints the finding summary — docs/how_to/graph_lint.md
 python tools/graph_lint.py --check
 
+stage "compiled-program cache (zero-recompile warm restart)"
+# the persisted-program drill (docs/how_to/compiled_programs.md): run
+# the compile-heavy trainer + Predictor + ModelServer driver twice
+# against ONE cache dir.  The first run fills the cache (compiles > 0,
+# every executable persisted); the second run must deserialize every
+# program — the script FAILS unless its lazy-trace count and compile
+# count are both ZERO and the output fingerprints match the cold run
+# bit-for-bit.  HARD timeout: a wedged deserialization must fail this
+# stage, not hang the suite.
+PROG_CACHE="$(mktemp -d)"
+timeout -k 10 420 env JAX_PLATFORMS=cpu MXTPU_PROGRAM_CACHE="$PROG_CACHE" \
+    python tests/nightly/program_warm.py --expect cold \
+    --json "$PROG_CACHE/cold.json"
+timeout -k 10 420 env JAX_PLATFORMS=cpu MXTPU_PROGRAM_CACHE="$PROG_CACHE" \
+    python tests/nightly/program_warm.py --expect warm \
+    --ref "$PROG_CACHE/cold.json"
+rm -rf "$PROG_CACHE"
+
 stage "comm lint gate (static collective-communication analysis)"
 # extracts the comm plan (collective, axis, dtype, predicted wire
 # bytes, layer provenance) of the fused ZeRO-1+bf16 trainer step, the

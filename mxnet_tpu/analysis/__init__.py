@@ -30,6 +30,8 @@ from . import comm_passes   # noqa: F401  registers the comm passes
 from .comm_passes import (CommEntry, extract_comm_plan, lint_comm,
                           lint_comm_source, plan_digest, plan_wire_gb,
                           scan_rank_divergence)
+from . import program_passes  # noqa: F401  registers program-bypass
+from .program_passes import lint_program_source, scan_program_bypass
 from .baseline import (BASELINE_PATH, baseline_entry, check_baseline,
                        load_baseline, run_gate, write_baseline)
 
@@ -45,5 +47,6 @@ __all__ = [
     "plan_digest", "plan_wire_gb", "scan_rank_divergence",
     "BASELINE_PATH", "baseline_entry", "check_baseline", "load_baseline",
     "run_gate", "write_baseline", "symbol_passes", "jaxpr_passes",
-    "concurrency", "comm_passes",
+    "concurrency", "comm_passes", "program_passes",
+    "lint_program_source", "scan_program_bypass",
 ]

@@ -1,0 +1,173 @@
+"""The ``program-bypass`` lint: keep every compile on the unified
+:class:`~mxnet_tpu.program.CompiledProgram` path.
+
+PR 13 promoted the serving cache's compiled-forward into the one
+compiled-program artifact the trainer, the executor bind path, and the
+ModelServer all consume — counted traces, one AOT-signature registry,
+and the persisted program cache (``MXTPU_PROGRAM_CACHE``) all hang off
+it.  A ``jax.jit(...)`` / ``pjit(...)`` or a ``.lower(...).compile()``
+chain issued PRIVATELY inside one of those layers re-opens the hole
+this refactor closed: the program is invisible to the retrace counters,
+skipped by the warm-restart cache, and unattributed in the
+``compile.*`` spans.
+
+Rule (severity **warn**, level ``program-source``):
+
+* ``program-bypass`` — a compile-issuing call in a unified-path layer
+  (``parallel/trainer.py``, ``executor.py``, ``serving/``,
+  ``predictor.py``) outside ``program.py`` itself.  Layer provenance is
+  the enclosing class/function.  Suppress a deliberate site with a
+  ``# program: ok <why>`` line comment (same discipline as
+  ``# tsan: ok`` / ``# comm: ok``).
+
+Gated at ZERO findings in ``LINT_BASELINE.json`` (target
+``program-source``) by ``tools/graph_lint.py --check``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, List, Optional
+
+from .core import WARN, ERROR, Finding, GraphPass, LintReport, \
+    PassContext, register_pass, run_passes
+
+__all__ = ["scan_program_bypass", "lint_program_source",
+           "UNIFIED_PATH_FILES"]
+
+# the layers whose compiles must flow through program.CompiledProgram
+# (relative to the mxnet_tpu package root)
+UNIFIED_PATH_FILES = (
+    "executor.py",
+    "predictor.py",
+    os.path.join("parallel", "trainer.py"),
+    "serving",
+)
+
+_SUPPRESS = "program: ok"
+
+
+def _terminal(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _compile_call(node) -> Optional[str]:
+    """The bypass spelling a Call node uses, or None.
+
+    * ``jax.jit(...)`` / bare ``jit(...)`` imported from jax /
+      ``pjit(...)``
+    * ``<expr>.lower(...).compile()`` — the AOT chain
+    """
+    if not isinstance(node, ast.Call):
+        return None
+    name = _terminal(node.func)
+    if name == "jit":
+        # jax.jit / jax_mod.jit — NOT program.jit / self.jit (the
+        # unified path's own spellings)
+        if isinstance(node.func, ast.Attribute):
+            base = _terminal(node.func.value)
+            if base in ("jax", "_jax"):
+                return "jax.jit"
+            return None
+        return None         # bare jit() — this repo never imports it
+    if name == "pjit":
+        return "pjit"
+    if name == "compile" and isinstance(node.func, ast.Attribute):
+        inner = node.func.value
+        if isinstance(inner, ast.Call) and \
+                _terminal(inner.func) == "lower":
+            return "lower().compile()"
+    return None
+
+
+def _scan_file(path: str, rel: str) -> List[Finding]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Finding("source-parse", ERROR, rel, "<source>",
+                        "could not parse: %s" % e)]
+    lines = src.splitlines()
+    suppressed = {i + 1 for i, line in enumerate(lines)
+                  if _SUPPRESS in line}
+    findings: List[Finding] = []
+
+    def visit(node, scope):
+        here = scope
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            here = "%s.%s" % (scope, node.name) if scope else node.name
+        spelled = _compile_call(node)
+        if spelled is not None and node.lineno not in suppressed:
+            findings.append(Finding(
+                "program-bypass", WARN,
+                "%s:%d" % (rel, node.lineno), spelled,
+                "compile issued outside the unified CompiledProgram "
+                "path: %s in %s — route it through "
+                "mxnet_tpu.program.CompiledProgram (counted traces, "
+                "AOT registry, persisted cache) or mark a deliberate "
+                "site '# %s <why>'"
+                % (spelled, here or "<module>", _SUPPRESS),
+                layer=here or "<module>"))
+        for ch in ast.iter_child_nodes(node):
+            visit(ch, here)
+
+    visit(tree, None)
+    return findings
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def scan_program_bypass(root: Optional[str] = None) -> List[Finding]:
+    """The ``program-bypass`` rule over the unified-path layers under
+    ``root`` (default: the installed ``mxnet_tpu`` package)."""
+    root = root or _package_root()
+    base = os.path.dirname(os.path.abspath(root.rstrip(os.sep)))
+    findings: List[Finding] = []
+    targets: List[str] = []
+    for entry in UNIFIED_PATH_FILES:
+        p = os.path.join(root, entry)
+        if os.path.isdir(p):
+            for fn in sorted(os.listdir(p)):
+                if fn.endswith(".py"):
+                    targets.append(os.path.join(p, fn))
+        elif os.path.exists(p):
+            targets.append(p)
+    for path in targets:
+        findings.extend(_scan_file(path, os.path.relpath(path, base)))
+    return findings
+
+
+@register_pass
+class ProgramBypassPass(GraphPass):
+    """AST rule: private jit/lower+compile in a unified-path layer."""
+
+    name = "program-bypass"
+    level = "program-source"
+    doc = "compile issued outside the unified CompiledProgram path " \
+          "(trainer / executor / serving layers)"
+
+    def run(self, ctx: PassContext):
+        return scan_program_bypass(ctx.config.get("source_root"))
+
+
+def lint_program_source(root: Optional[str] = None,
+                        config: Optional[Dict[str, Any]] = None
+                        ) -> LintReport:
+    """Run the program-source rules over a source tree into one
+    report (the ``program-source`` graph_lint target)."""
+    cfg = dict(config or {})
+    if root is not None:
+        cfg["source_root"] = root
+    report = LintReport(model="program-source")
+    ctx = PassContext(config=cfg)
+    report.extend(run_passes(ctx, "program-source"))
+    report.traced = True
+    return report

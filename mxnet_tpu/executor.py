@@ -129,7 +129,21 @@ class _GraphProgram:
             def fn(arg_vals, aux_vals, rng_key):
                 return self._eval(list(arg_vals), list(aux_vals), rng_key,
                                   is_train)
-            self._jitted[is_train] = jax.jit(fn)
+            # one unified compiled-program artifact per (symbol, mode):
+            # counted, lint-visible, and — eval mode, MXTPU_PROGRAM_CACHE
+            # armed — persisted, so a re-bound process loads the forward
+            # instead of re-tracing it.  group2ctx placements pin nodes
+            # to concrete local devices, which don't belong in a
+            # cross-process key: those programs stay in-memory only.
+            from . import program as _program
+            key = None
+            if not self.placement:
+                key = {"symbol": _program.symbol_digest(self.sym),
+                       "train": bool(is_train),
+                       "platform": self.platform,
+                       "dtype_policy": self.dtype_policy}
+            self._jitted[is_train] = _program.CompiledProgram(
+                "executor.forward", fn, key=key)
         return self._jitted[is_train]
 
 

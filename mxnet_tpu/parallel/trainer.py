@@ -32,6 +32,7 @@ from ..initializer import InitDesc
 from .. import initializer as _init_mod
 from .. import faults as _faults
 from .. import obs as _obs
+from .. import program as _program
 from .mesh import batch_sharding, replicated
 from .optim import make_update_fn
 
@@ -330,6 +331,74 @@ class Trainer:
             return 1
         return int(dict(self.mesh.shape).get("data", 1))
 
+    def _program_key(self) -> Dict:
+        """Identity fields of this trainer's compiled programs beyond
+        the abstract call signature — everything that is BAKED into the
+        traced step (optimizer hyperparameters become XLA constants;
+        the config knobs choose which step variant is traced).  Two
+        processes whose keys and signatures agree run the same program,
+        so a persisted executable (``MXTPU_PROGRAM_CACHE``) is safe to
+        reuse; anything volatile (lr — a runtime argument — and the
+        host-side update counters) is deliberately excluded."""
+        volatile = {"lr", "num_update", "begin_num_update"}
+
+        def _jsonable(v):
+            # scalars AND containers of scalars: lr_mult/wd_mult dicts
+            # are baked per-param into the update math (optim.py
+            # `scales`), so they MUST key the program — a filter that
+            # kept only scalars would let two wd_mult configs share one
+            # executable (silent wrong-update on a warm cache)
+            if isinstance(v, (int, float, str, bool, type(None))):
+                return v
+            if isinstance(v, dict):
+                return {str(k): _jsonable(x)
+                        for k, x in sorted(v.items())}
+            if isinstance(v, (list, tuple)):
+                return [_jsonable(x) for x in v]
+            if isinstance(v, (set, frozenset)):
+                return sorted(str(x) for x in v)
+            raise TypeError(type(v))
+
+        opt, opaque = {}, []
+        for k, v in sorted(vars(self.optimizer).items()):
+            if k in volatile:
+                continue
+            try:
+                opt[k] = _jsonable(v)
+            except TypeError:
+                # objects (lr_scheduler: host-side, lr arrives as a
+                # runtime arg) — record the field NAME so presence
+                # still keys, content doesn't churn the key with
+                # per-process reprs
+                opaque.append(k)
+        if opaque:
+            opt["_opaque_fields"] = opaque
+        mesh_desc = None
+        if self.mesh is not None:
+            mesh_desc = {"axes": dict(self.mesh.shape),
+                         "devices": int(self.mesh.size)}
+        return {
+            "symbol": _program.symbol_digest(self.symbol),
+            "optimizer": [type(self.optimizer).__name__, opt],
+            "compute_dtype": str(self.compute_dtype)
+            if self.compute_dtype is not None else None,
+            "dtype_policy": self.dtype_policy,
+            "platform": self.prog.platform,
+            "remat": self.remat,
+            "sentinel": self.sentinel,
+            "loss_scale": str(self.loss_scale),
+            "ls_growth_interval": self.ls_growth_interval,
+            "zero": self.zero,
+            "grad_accum": self.grad_accum,
+            "grad_dtype": self.grad_dtype,
+            "integrity": [self._integ_mode, self.integrity_period],
+            "donate_batch": self.donate_batch,
+            "mesh": mesh_desc,
+            "param_specs": sorted((n, str(s))
+                                  for n, s in self.param_specs.items()),
+            "multihost": self.multihost,
+        }
+
     # ------------------------------------------------------------------
     def bind(self, data_shapes: Dict[str, tuple],
              label_shapes: Optional[Dict[str, tuple]] = None):
@@ -422,17 +491,19 @@ class Trainer:
         self.params, self.aux = params, aux
         init_fn, self._update_fn = make_update_fn(
             self.optimizer, self.param_names)
-        if self._opt_shardings is not None:
-            # state is born on its PLANNED sharding (zeros are not
-            # sharding-connected to the weights, so propagation alone
-            # could commit them anywhere).  Under zero=1 that means born
-            # SHARDED: each chip materializes only its owned slice —
-            # peak HBM never holds the replicated copy a post-hoc
-            # reshard would
-            self.opt_state = jax.jit(
-                init_fn, out_shardings=self._opt_shardings)(params)
-        else:
-            self.opt_state = jax.jit(init_fn)(params)
+        init_kw = {} if self._opt_shardings is None else \
+            {"out_shardings": self._opt_shardings}
+        # state is born on its PLANNED sharding (zeros are not
+        # sharding-connected to the weights, so propagation alone
+        # could commit them anywhere).  Under zero=1 that means born
+        # SHARDED: each chip materializes only its owned slice —
+        # peak HBM never holds the replicated copy a post-hoc
+        # reshard would.  A CompiledProgram like the step itself, so a
+        # warm program cache also skips the init compile.
+        self.opt_state = _program.CompiledProgram(
+            "trainer.opt_init", init_fn,
+            key=dict(self._pkey, prog="opt_init"),
+            jit_kwargs=init_kw)(params)
         if self.sentinel != "off" and self._sent is None:
             # created once per trainer, NOT per (re-)init: init_params
             # doesn't reset num_update, and Module.fit's epoch-end
@@ -1081,32 +1152,48 @@ class Trainer:
             # keeps every donated state write a true in-place update.
             # Sentinel/integrity scalars and the graph outputs stay
             # unpinned.
+        # every trainer program is a CompiledProgram artifact: counted
+        # traces, one lint/obs surface, and — with MXTPU_PROGRAM_CACHE
+        # armed — a persisted AOT executable a restarted process loads
+        # instead of recompiling (docs/how_to/compiled_programs.md)
+        self._pkey = pkey = self._program_key()
+
+        def _prog_of(name, fn, **jkw):
+            return _program.CompiledProgram(
+                "trainer.%s" % name, fn, key=dict(pkey, prog=name),
+                jit_kwargs=jkw)
+
+        if self.mesh is not None and self.mesh.size > 1:
             in_core = (p_shard, a_shard, opt_in) + (None,) * n_sent
             in_tail = (self._batch_shardings, None, None, None)
             out_core = (p_shard, a_shard, opt_in) + (None,) * n_sent
-            self._step_fn = jax.jit(step_fn,
-                                    in_shardings=in_core + in_tail,
-                                    out_shardings=out_core + (None,),
-                                    donate_argnums=donate)
+            self._step_fn = _prog_of(
+                "step", step_fn,
+                in_shardings=in_core + in_tail,
+                out_shardings=out_core + (None,),
+                donate_argnums=donate)
             if step_check is not None:
-                self._step_check_fn = jax.jit(
-                    step_check,
+                self._step_check_fn = _prog_of(
+                    "step_check", step_check,
                     in_shardings=in_core + (None,) + in_tail,
                     out_shardings=out_core + (None, None),
                     donate_argnums=donate_check)
-            self._eval_fn = jax.jit(
-                evaluate,
-                in_shardings=(p_shard, a_shard, self._batch_shardings, None))
-            self._eval_train_fn = jax.jit(
-                evaluate_train,
-                in_shardings=(p_shard, a_shard, self._batch_shardings, None))
+            self._eval_fn = _prog_of(
+                "eval", evaluate,
+                in_shardings=(p_shard, a_shard, self._batch_shardings,
+                              None))
+            self._eval_train_fn = _prog_of(
+                "eval_train", evaluate_train,
+                in_shardings=(p_shard, a_shard, self._batch_shardings,
+                              None))
         else:
-            self._step_fn = jax.jit(step_fn, donate_argnums=donate)
+            self._step_fn = _prog_of("step", step_fn,
+                                     donate_argnums=donate)
             if step_check is not None:
-                self._step_check_fn = jax.jit(
-                    step_check, donate_argnums=donate_check)
-            self._eval_fn = jax.jit(evaluate)
-            self._eval_train_fn = jax.jit(evaluate_train)
+                self._step_check_fn = _prog_of("step_check", step_check,
+                                               donate_argnums=donate_check)
+            self._eval_fn = _prog_of("eval", evaluate)
+            self._eval_train_fn = _prog_of("eval_train", evaluate_train)
 
     # ------------------------------------------------------------------
     def _device_batch(self, batch: Dict) -> Dict:
@@ -1270,7 +1357,9 @@ class Trainer:
                 lf = jnp.stack([_integrity.leaf_fingerprint(v)
                                 for v in leaves])
                 return _integrity.fold_fingerprints(lf, salts), lf
-            self._fp_fn = jax.jit(fp_impl)
+            self._fp_fn = _program.CompiledProgram(
+                "trainer.fp", fp_impl,
+                key=dict(self._pkey, prog="fp"))
         return self._fp_fn([v for _, v in named], salts)
 
     def state_fingerprint(self) -> dict:
@@ -1315,7 +1404,9 @@ class Trainer:
         from .. import integrity as _integrity
         from ..integrity import IntegrityError
         if self._vote_fn is None:
-            self._vote_fn = jax.jit(self._make_integ_update())
+            self._vote_fn = _program.CompiledProgram(
+                "trainer.vote", self._make_integ_update(),
+                key=dict(self._pkey, prog="vote"))
         integ = self._vote_fn(
             self.params, self.aux, self.opt_state, self._init_integ(),
             jnp.bool_(True), jnp.int32(max(1, self.num_update)))
@@ -1341,7 +1432,9 @@ class Trainer:
         operand from its shards and launder the divergence).  One extra
         dispatch per integrity period."""
         if self._vote_fn is None:
-            self._vote_fn = jax.jit(self._make_integ_update())
+            self._vote_fn = _program.CompiledProgram(
+                "trainer.vote", self._make_integ_update(),
+                key=dict(self._pkey, prog="vote"))
         self._integ = self._vote_fn(
             self.params, self.aux, self.opt_state, self._integ,
             jnp.bool_(True), jnp.int32(max(1, self.num_update)))

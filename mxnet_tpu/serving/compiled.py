@@ -15,6 +15,14 @@ trick the fused trainer step uses), so
 * the weights live on device once per model, passed by reference into
   whichever bucket executable runs — no per-bucket copies, no rebind.
 
+:class:`CompiledForward` is the serving face of the general
+:class:`~mxnet_tpu.program.CompiledProgram` artifact — the trace
+counting, AOT-signature registry, and the **persisted program cache**
+(``MXTPU_PROGRAM_CACHE``: a second process over the same model loads
+serialized executables instead of compiling) all live in the base
+class; this module adds the symbol/bucket semantics and the serving
+latency EWMA.
+
 Retrace accounting: the traced python body bumps ``trace_count`` (jax
 runs it exactly once per distinct input signature), and
 ``aot_compile`` records the deliberately pre-compiled signatures; any
@@ -25,17 +33,15 @@ stays zero in steady state, and the ``serve-shape-bucket`` lint pass
 """
 from __future__ import annotations
 
-import hashlib
-import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..executor import _GraphProgram
+from ..program import CompiledProgram, symbol_digest as _symbol_digest
 from .. import _tsan
 
 __all__ = ["CompiledForward", "compiled_forward", "cache_stats",
@@ -66,15 +72,17 @@ def infer_input_dtypes(symbol, params, input_names: Sequence[str],
     return out
 
 
-class CompiledForward:
+class CompiledForward(CompiledProgram):
     """A symbol's inference forward, jitted once, weights as arguments.
 
     ``run(params, aux, batch)`` executes at whatever batch signature the
     inputs carry; signatures registered through :meth:`aot_compile`
     execute from the ahead-of-time compiled cache (zero trace work on
     the hot path — ``jit.lower().compile()`` shares the jit's executable
-    cache, verified on this jax), anything else traces on first use and
-    counts as a retrace.
+    cache, verified on this jax) or, with ``MXTPU_PROGRAM_CACHE``
+    armed, from a deserialized on-disk executable (zero trace AND zero
+    compile); anything else traces on first use and counts as a
+    retrace.
     """
 
     def __init__(self, symbol, input_names: Sequence[str],
@@ -94,16 +102,12 @@ class CompiledForward:
         self.param_names = [n for n in self.prog.arg_names
                             if n not in set(self.input_names)]
         self.aux_names = list(self.prog.aux_names)
-        self.trace_count = 0            # bumped in the traced body
         self.traced_batch_sizes: List[int] = []   # one entry per trace
         # traces that happened OUTSIDE an aot_compile call — each one
         # was a trace+compile stall on some caller's hot path.  A
         # Predictor's construction-time warmup or a server bucket is
         # AOT; only lazy traces count as retraces / lint findings.
         self.lazy_batch_sizes: List[int] = []
-        self._aot_keys: set = set()     # signatures compiled at startup
-        self._aot_tls = threading.local()
-        self._lock = _tsan.lock("serving.CompiledForward._lock")
         # execute-latency EWMA (overall + per padded batch size), fed by
         # the server after each dispatched batch and consumed by its
         # deadline-aware shedding — a program property (one executable,
@@ -119,30 +123,36 @@ class CompiledForward:
         param_set = set(self.param_names)
         arg_names = list(self.prog.arg_names)
         aux_names = self.aux_names
+        gprog = self.prog
 
         def _fwd(params, aux, batch, key):
-            # trace-time side effects: jax runs this body exactly once
-            # per distinct input signature — the compilation counter.
-            # The AOT flag is thread-local: aot_compile's lower() runs
-            # the trace on the calling thread, so a concurrent lazy
-            # trace on another thread is still attributed correctly.
-            with self._lock:
-                if _tsan.TSAN:
-                    _tsan.note_write("serving.CompiledForward.counters")
-                self.trace_count += 1
-                b = self._batch_dim(batch)
-                self.traced_batch_sizes.append(b)
-                if not getattr(self._aot_tls, "active", False):
-                    self.lazy_batch_sizes.append(b)
             vals = [params[n] if n in param_set else batch[n]
                     for n in arg_names]
-            outs, _ = self.prog._eval(vals, [aux[n] for n in aux_names],
-                                      key, False)
+            outs, _ = gprog._eval(vals, [aux[n] for n in aux_names],
+                                  key, False)
             return outs
 
-        self._jit = jax.jit(_fwd)
+        super().__init__(
+            "serving.forward", _fwd,
+            key={"symbol": _symbol_digest(symbol),
+                 "inputs": tuple(sorted(self.input_names)),
+                 "platform": platform, "dtype_policy": dtype_policy})
 
     # ------------------------------------------------------------------
+    def _on_trace(self, args, lazy: bool) -> None:
+        # called under the counter lock, once per traced signature —
+        # args = (params, aux, batch, key)
+        b = self._batch_dim(args[2])
+        self.traced_batch_sizes.append(b)
+        if lazy:
+            self.lazy_batch_sizes.append(b)
+
+    def _trace_tag(self, args) -> str:
+        return "serving.forward@b%d" % self._batch_dim(args[2])
+
+    def _extend_counts(self, d: Dict) -> None:
+        d["lazy_batch_sizes"] = list(self.lazy_batch_sizes)
+
     def _batch_dim(self, batch) -> int:
         for n in self.input_names:
             v = batch.get(n)
@@ -150,34 +160,27 @@ class CompiledForward:
                 return int(v.shape[0])
         return 0
 
-    @staticmethod
-    def _sig(batch) -> Tuple:
-        # sharding is part of the jit signature: the same shapes warmed
-        # replicated and mesh-sharded are two distinct compilations
-        return tuple(sorted((n, tuple(v.shape), str(np.dtype(v.dtype)),
-                             str(getattr(v, "sharding", None)))
-                            for n, v in batch.items()))
-
     def aot_compile(self, params, aux, batch_shapes: Dict[str, tuple],
                     batch_dtypes: Optional[Dict] = None,
-                    batch_shardings: Optional[Dict] = None) -> None:
+                    batch_shardings: Optional[Dict] = None) -> str:
         """Lower + compile one input signature ahead of time (server
         start / Predictor bind).  ``params``/``aux`` provide the weight
         avals (values or ShapeDtypeStructs — only shape/dtype/sharding
         are read).  On a mesh the caller passes ``batch_shardings`` so
         the warmed signature matches the committed batches the hot path
         feeds — a signature mismatch here would silently turn every
-        "pre-compiled" call into a retrace."""
+        "pre-compiled" call into a retrace.
+
+        Returns the base artifact's verdict: ``"cached"`` (signature
+        already warm), ``"loaded"`` (deserialized from the persisted
+        program cache — the caller's execute-once warmup is then pure
+        dispatch setup, no trace/compile), or ``"compiled"``."""
         batch_dtypes = batch_dtypes or {}
         batch_shardings = batch_shardings or {}
         sds = {n: jax.ShapeDtypeStruct(
             tuple(s), np.dtype(batch_dtypes.get(n, np.float32)),
             sharding=batch_shardings.get(n))
             for n, s in batch_shapes.items()}
-        key = self._sig(sds)
-        with self._lock:
-            if key in self._aot_keys:
-                return
 
         def _wsds(v):
             sh = getattr(v, "sharding", None)
@@ -187,24 +190,13 @@ class CompiledForward:
 
         p_sds = {n: _wsds(v) for n, v in params.items()}
         a_sds = {n: _wsds(v) for n, v in aux.items()}
-        # .lower() traces (counted once by _fwd); .compile() lands the
-        # executable in the jit cache, so the later run() at this
-        # signature is a pure cache hit
-        self._aot_tls.active = True
-        try:
-            self._jit.lower(p_sds, a_sds, sds, self._rng).compile()
-        finally:
-            self._aot_tls.active = False
-        with self._lock:
-            if _tsan.TSAN:
-                _tsan.note_write("serving.CompiledForward.counters")
-            self._aot_keys.add(key)
+        return self.aot(p_sds, a_sds, sds, self._rng)
 
     def run(self, params, aux, batch: Dict) -> Tuple:
         """Execute the forward.  ``batch`` maps every input name to a
         host or device array; returns the output tuple (device
         arrays)."""
-        return self._jit(params, aux, batch, self._rng)
+        return self(params, aux, batch, self._rng)
 
     # ------------------------------------------------------------------
     # latency bookkeeping (the server's deadline-aware shed reads this)
@@ -241,20 +233,6 @@ class CompiledForward:
                     for b, v in sorted(self._bucket_run_s.items())}
 
     # ------------------------------------------------------------------
-    def counts(self) -> Dict:
-        """One atomic snapshot of the trace accounting — traces, AOT
-        signatures, retraces, and the lazily-traced batch sizes — taken
-        under the counter lock so a concurrent trace on another thread
-        can never be read mid-update (``ModelServer.stats`` and the
-        lint path both consume this)."""
-        with self._lock:
-            if _tsan.TSAN:
-                _tsan.note_read("serving.CompiledForward.counters")
-            return {"traces": self.trace_count,
-                    "aot": len(self._aot_keys),
-                    "retraces": len(self.lazy_batch_sizes),
-                    "lazy_batch_sizes": list(self.lazy_batch_sizes)}
-
     @property
     def aot_count(self) -> int:
         return self.counts()["aot"]
@@ -280,10 +258,6 @@ _CACHE: Dict[Tuple, CompiledForward] = {}
 _CACHE_LOCK = _tsan.lock("serving.compiled._CACHE_LOCK")
 _HITS = 0
 _MISSES = 0
-
-
-def _symbol_digest(symbol) -> str:
-    return hashlib.sha1(symbol.tojson().encode()).hexdigest()
 
 
 def compiled_forward(symbol, input_names: Sequence[str],

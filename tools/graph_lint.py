@@ -187,7 +187,8 @@ def main(argv=None):
                 model=name)
     else:
         targets = bench_targets()
-        names = args.model or sorted(targets) + ["trainer-step", "serving"]
+        names = args.model or sorted(targets) + ["trainer-step", "serving",
+                                                 "program-source"]
         for name in names:
             if name == "trainer-step":
                 reports[name] = trainer_step_report()
@@ -195,9 +196,17 @@ def main(argv=None):
             if name == "serving":
                 reports[name] = serving_report()
                 continue
+            if name == "program-source":
+                # the program-bypass AST rule over the unified-path
+                # layers (trainer / executor / serving / predictor):
+                # every compile must flow through
+                # mxnet_tpu.program.CompiledProgram — baseline holds
+                # ZERO findings (docs/how_to/compiled_programs.md)
+                reports[name] = analysis.lint_program_source()
+                continue
             if name not in targets:
                 raise SystemExit("unknown bench model %r (have %s, "
-                                 "trainer-step, serving)"
+                                 "trainer-step, serving, program-source)"
                                  % (name, sorted(targets)))
             t = targets[name]
             reports[name] = analysis.lint_symbol(

@@ -177,6 +177,35 @@ def training_breakdown(spans):
             "per_step": rows}
 
 
+def compile_breakdown(spans):
+    """Where startup time went (docs/how_to/compiled_programs.md): the
+    ``compile.trace`` / ``compile.compile`` / ``compile.load`` spans
+    the unified CompiledProgram path emits, folded per phase and per
+    artifact kind.  A warm restart shows ``compile.load`` rows only —
+    a ``compile.compile`` row on a supposedly-warm start IS the
+    regression."""
+    phases, kinds = {}, {}
+    total = 0.0
+    for s in spans:
+        n = s["n"]
+        if not n.startswith("compile."):
+            continue
+        dt = s["t1"] - s["t0"]
+        total += dt
+        phases.setdefault(n, []).append(dt)
+        kind = (s.get("a") or {}).get("kind", "?")
+        k = kinds.setdefault("%s:%s" % (kind, n.split(".", 1)[1]),
+                             [0, 0.0])
+        k[0] += 1
+        k[1] += dt
+    return {
+        "total_ms": round(total * 1e3, 3),
+        "phases": {k: _pcts(v) for k, v in sorted(phases.items())},
+        "by_kind": {k: {"count": c, "total_ms": round(t * 1e3, 3)}
+                    for k, (c, t) in sorted(kinds.items())},
+    }
+
+
 def metrics_summary(events):
     """Fold the periodic metric-delta lines: summed counter deltas,
     last gauge values, last histogram snapshots."""
@@ -223,6 +252,7 @@ def report(paths, tol_pct=5.0):
         "unclosed": unclosed,
         "serving": serving_breakdown(spans, tol_pct=tol_pct),
         "training": training_breakdown(spans),
+        "compile": compile_breakdown(spans),
         "metrics": metrics_summary(events),
     }, spans
 
@@ -278,6 +308,12 @@ def main(argv=None):
                      ", step p50 %.3f / p99 %.3f ms"
                      % (p["p50_ms"], p["p99_ms"]) if p else ""))
             print("\n".join(_fmt_segments("segments", trn["segments"])))
+        cmp_ = rep["compile"]
+        if cmp_["by_kind"]:
+            print("compile/startup: %.1f ms total" % cmp_["total_ms"])
+            for k, row in cmp_["by_kind"].items():
+                print("    %-28s x%-3d %10.2f ms"
+                      % (k, row["count"], row["total_ms"]))
 
     if args.check:
         failures = []
