@@ -731,15 +731,16 @@ def main():
             pipe["pipeline_img_per_sec"] / bound, 3)
         line.update(pipe)
     try:
-        from tools.stepcost import compile_step, cost_analysis
+        # one code path with the autotuner's surrogate and the nightly
+        # byte-budget gate (tools/step_breakdown.step_cost)
+        from tools.step_breakdown import step_cost
         roof = json.load(open(os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "ROOFLINE.json")))
-        comp = compile_step(mod._trainer, {
+        sc = step_cost(mod._trainer, {
             k: v.data for k, v in
             zip(["data", "softmax_label"],
                 data_batch.data + data_batch.label)})
-        ca = cost_analysis(comp)
-        flops, byts = ca["flops"], ca["bytes"]
+        flops, byts = sc["flops"], sc["bytes"]
         step_tflops = flops * (img_s / batch) / 1e12
         line["remat_policy"] = mod._trainer.remat
         line["achieved_tflops"] = round(step_tflops, 1)
@@ -914,6 +915,23 @@ def main():
                 % (ov["max_load_factor"], ov["goodput_max_load_rps"],
                    ov["base_load_factor"], ov["goodput_base_rps"]))
 
+    # --- tune-plan A/B (docs/how_to/autotune.md): when a persisted
+    # TUNE_PLAN.json exists (checked in at the repo root, or pointed at
+    # via MXTPU_TUNE_PLAN), A/B its serving config against the built-in
+    # defaults on one identical seeded arrival sequence and record the
+    # headline delta — the figure the committed plan's win rests on.
+    # Every timed window also appends a (config, measured) row to
+    # TUNE_CORPUS.jsonl.  MXTPU_BENCH_TUNE=0 skips.
+    if os.environ.get("MXTPU_BENCH_TUNE", "1") != "0":
+        plan_path = os.environ.get("MXTPU_TUNE_PLAN") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "TUNE_PLAN.json")
+        if os.path.exists(plan_path):
+            try:
+                from tools.autotune import plan_ab
+                line["tune"] = plan_ab(plan_path, quick=True)
+            except Exception as e:                  # noqa: BLE001
+                line["tune_error"] = str(e)
+
     # --- telemetry overhead (docs/how_to/observability.md): the span
     # recorder + JSONL exporter must stay inside 5% of the serving hot
     # path when armed (MXTPU_OBS=1) — alternating OFF/ON closed-loop
@@ -1071,6 +1089,33 @@ def main():
                 line["stream_" + k] = v
         except Exception as e:                      # noqa: BLE001
             line["stream_error"] = str(e)
+
+    # --- tune corpus: the bench headline is itself a (config, measured)
+    # pair — append it so every bench run grows the TpuGraphs-style
+    # accumulation a learned cost model will train on
+    # (docs/how_to/autotune.md "The corpus")
+    try:
+        from mxnet_tpu import tuneplan
+        tr = mod._trainer
+        tuneplan.append_corpus({
+            "kind": "train", "tool": "bench",
+            "config": {"model": "resnet-50", "batch": batch,
+                       "image": image,
+                       "dtype_policy": tr.dtype_policy,
+                       "remat": tr.remat, "zero": tr.zero,
+                       "grad_accum": tr.grad_accum,
+                       "grad_dtype": tr.grad_dtype,
+                       "sentinel": tr.sentinel,
+                       "integrity": tr._integ_mode},
+            "measured": {
+                "img_per_sec": line["value"],
+                "cost_model_gb_per_step":
+                    line.get("cost_model_gb_per_step"),
+                "grad_comm_gb_per_step":
+                    line.get("grad_comm_gb_per_step"),
+                "achieved_tflops": line.get("achieved_tflops")}})
+    except Exception as e:                          # noqa: BLE001
+        line["tune_corpus_error"] = str(e)
 
     print(json.dumps(line))
 

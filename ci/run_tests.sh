@@ -150,6 +150,25 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu MXTPU_PROGRAM_CACHE="$PROG_CACHE" \
     --ref "$PROG_CACHE/cold.json"
 rm -rf "$PROG_CACHE"
 
+stage "micro-tune (surrogate search + timed A/B emits a loadable, no-worse plan)"
+# the search-based autotuner's CI cut (docs/how_to/autotune.md): 2-3
+# knobs, byte-cost-model + serving-EWMA surrogates, one timed trial per
+# A/B side against a warm program cache — the tool itself asserts the
+# warm recheck compiles ZERO programs and (--assert-no-worse) that the
+# emitted plan is no worse than the defaults on the measured window;
+# --verify then loads the plan back through a REAL Trainer +
+# ModelServer in a fresh process and asserts every section applied.
+# HARD timeout: a wedged trial server must fail this stage, not hang CI.
+TUNE_TMP="$(mktemp -d)"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    MXTPU_PROGRAM_CACHE="$TUNE_TMP/cache" \
+    MXTPU_TUNE_CORPUS="$TUNE_TMP/TUNE_CORPUS.jsonl" \
+    python tools/autotune.py --micro --out "$TUNE_TMP/TUNE_PLAN.json" \
+        --corpus "$TUNE_TMP/TUNE_CORPUS.jsonl" --assert-no-worse
+timeout -k 10 180 env JAX_PLATFORMS=cpu \
+    python tools/autotune.py --verify "$TUNE_TMP/TUNE_PLAN.json"
+rm -rf "$TUNE_TMP"
+
 stage "comm lint gate (static collective-communication analysis)"
 # extracts the comm plan (collective, axis, dtype, predicted wire
 # bytes, layer provenance) of the fused ZeRO-1+bf16 trainer step, the

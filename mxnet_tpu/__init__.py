@@ -175,6 +175,13 @@ from .resilience import CheckpointManager
 from . import integrity
 from .integrity import IntegrityError  # noqa: F401
 from . import health
+from . import envknobs
+from . import tuneplan
+
+# one scan of the MXTPU_* env surface per process: a typo'd knob
+# (MXTPU_GRAD_ACUM=4) warns loudly with a did-you-mean instead of
+# silently configuring nothing; MXTPU_STRICT_KNOBS=1 raises instead
+envknobs.validate_environ()
 
 # Custom op front-ends (reference mx.nd.Custom / mx.sym.Custom)
 ndarray.Custom = operator._custom_entry("nd")

@@ -459,10 +459,16 @@ class BaseModule(object):
                     else None
             return resolve
 
+        # env beats the trainer's applied tune-plan entries beats the
+        # built-in defaults (docs/how_to/autotune.md)
+        from .. import envknobs as _envknobs
+        pk = getattr(tr, "plan_knobs", None) or {}
         return DeviceUploadIter(
             train_data,
-            depth=int(os.environ.get("MXTPU_UPLOAD_DEPTH", "2") or 2),
-            chunks=int(os.environ.get("MXTPU_UPLOAD_CHUNKS", "1") or 1),
+            depth=_envknobs.get_int("MXTPU_UPLOAD_DEPTH",
+                                    pk.get("upload_depth", 2)),
+            chunks=_envknobs.get_int("MXTPU_UPLOAD_CHUNKS",
+                                     pk.get("upload_chunks", 1)),
             data_shardings=_sh(self._data_names),
             label_shardings=_sh(self._label_names))
 

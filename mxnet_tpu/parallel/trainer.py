@@ -30,9 +30,11 @@ from ..ndarray import NDArray
 from ..executor import _GraphProgram
 from ..initializer import InitDesc
 from .. import initializer as _init_mod
+from .. import envknobs as _envknobs
 from .. import faults as _faults
 from .. import obs as _obs
 from .. import program as _program
+from .. import tuneplan as _tuneplan
 from .mesh import batch_sharding, replicated
 from .optim import make_update_fn
 
@@ -139,7 +141,8 @@ class Trainer:
                  grad_accum: Optional[int] = None,
                  grad_dtype: Optional[str] = None,
                  integrity: Optional[str] = None,
-                 integrity_period: Optional[int] = None):
+                 integrity_period: Optional[int] = None,
+                 plan=None):
         self.symbol = symbol
         self.optimizer = optimizer
         self.prog = _GraphProgram(symbol)
@@ -164,16 +167,39 @@ class Trainer:
             for d in mesh.devices.flat)
         self.compute_dtype = _dtype(compute_dtype) if compute_dtype else None
         import os as _os
-        self.remat = remat if remat is not None \
-            else _os.environ.get("MXTPU_REMAT", "none")
+        # --- persisted autotune plan (docs/how_to/autotune.md):
+        # ``plan=`` (a dict, a path, or None -> MXTPU_TUNE_PLAN) sits
+        # BELOW every explicit constructor argument and set env var —
+        # resolution is ctor > env > plan > default — and applies only
+        # when its key matches this (symbol, mesh, jax, platform); a
+        # foreign plan is a loud COUNTED fallback to defaults
+        # (``tune.plan_foreign``), never silent misconfiguration.
+        self.tune_plan = _tuneplan.resolve(plan)
+        tplan = {}
+        if self.tune_plan is not None:
+            tplan = _tuneplan.train_section(
+                self.tune_plan, _program.symbol_digest(symbol),
+                mesh=mesh, platform=self.prog.platform)
+        self.plan_knobs = tplan      # what actually applied (tests/obs)
+
+        def _knob(ctor, env_name, plan_key, default):
+            if ctor is not None:
+                return ctor
+            if _envknobs.is_set(env_name):
+                return _os.environ[env_name]
+            if plan_key is not None and plan_key in tplan:
+                return tplan[plan_key]
+            return default
+
+        self.remat = _knob(remat, "MXTPU_REMAT", "remat", "none")
         # residual/intermediate dtype policy (op/bytediet.py): the fused
         # step seeds bf16 cotangents (see ``step``) and the byte-diet
         # backward formulations keep elementwise math in that dtype with
         # f32-accumulated reductions; ``"legacy"`` restores the plain
         # autodiff backwards (A/B and bisection knob,
         # ``MXTPU_DTYPE_POLICY`` for the process default).
-        self.dtype_policy = dtype_policy if dtype_policy is not None \
-            else _os.environ.get("MXTPU_DTYPE_POLICY", None)
+        self.dtype_policy = _knob(dtype_policy, "MXTPU_DTYPE_POLICY",
+                                  "dtype_policy", None)
         self.prog.dtype_policy = self.dtype_policy
         # --- step sentinel (docs/how_to/resilience.md): watch the f32
         # grads' global finiteness INSIDE the jitted step and lax-select
@@ -225,8 +251,10 @@ class Trainer:
         # members after the step (Module.update_metric reads labels)
         # must keep it off.
         if donate_batch is None:
-            donate_batch = _os.environ.get("MXTPU_DONATE_BATCH",
-                                           "0") in ("1", "true", "yes")
+            if _envknobs.is_set("MXTPU_DONATE_BATCH"):
+                donate_batch = _envknobs.get_bool("MXTPU_DONATE_BATCH")
+            else:
+                donate_batch = bool(tplan.get("donate_batch", False))
         self.donate_batch = bool(donate_batch)
         self.param_specs = param_specs or {}
         # --- ZeRO-1 / gradient accumulation / reduced-precision grad
@@ -243,21 +271,20 @@ class Trainer:
                 raise MXNetError("%s=%r is not an integer" % (what, value)) \
                     from None
 
-        if zero is None:
-            zero = _os.environ.get("MXTPU_ZERO", "0")
+        zero = _knob(zero, "MXTPU_ZERO", "zero", "0")
         self.zero = _as_int(zero, "zero (MXTPU_ZERO)")
         if self.zero not in (0, 1):
             raise MXNetError("zero=%r: supported stages are 0 (replicated "
                              "optimizer state) and 1 (state sharded along "
                              "the data axis)" % (zero,))
-        if grad_accum is None:
-            grad_accum = _os.environ.get("MXTPU_GRAD_ACCUM", "1")
+        grad_accum = _knob(grad_accum, "MXTPU_GRAD_ACCUM", "grad_accum",
+                           "1")
         self.grad_accum = _as_int(grad_accum, "grad_accum (MXTPU_GRAD_ACCUM)")
         if self.grad_accum < 1:
             raise MXNetError("grad_accum=%r: need a microbatch count >= 1"
                              % (grad_accum,))
-        if grad_dtype is None:
-            grad_dtype = _os.environ.get("MXTPU_GRAD_DTYPE", "") or "f32"
+        grad_dtype = _knob(grad_dtype, "MXTPU_GRAD_DTYPE", "grad_dtype",
+                           "f32")
         _GD = {"f32": "f32", "float32": "f32",
                "bf16": "bf16", "bfloat16": "bf16"}
         if grad_dtype not in _GD:
@@ -288,9 +315,9 @@ class Trainer:
             raise MXNetError("unknown integrity mode %r (off|fp|vote|"
                              "audit)" % (integrity,))
         self.integrity = integrity
-        if integrity_period is None:
-            integrity_period = _os.environ.get("MXTPU_INTEGRITY_PERIOD",
-                                               "100")
+        integrity_period = _knob(integrity_period,
+                                 "MXTPU_INTEGRITY_PERIOD",
+                                 "integrity_period", "100")
         self.integrity_period = _as_int(
             integrity_period, "integrity_period (MXTPU_INTEGRITY_PERIOD)")
         if self.integrity != "off" and self.integrity_period < 1:

@@ -55,7 +55,7 @@ from . import _tsan
 from . import obs as _obs
 
 __all__ = ["CompiledProgram", "jit", "cache_dir", "cache_stats",
-           "reset_stats", "entry_path", "symbol_digest",
+           "reset_stats", "stats_delta", "entry_path", "symbol_digest",
            "PROGRAM_CACHE_VERSION"]
 
 # bump when the on-disk entry layout changes: older entries become
@@ -534,3 +534,24 @@ def reset_stats() -> None:
     """Zero the module counters (test isolation)."""
     for ctr in (_HITS, _MISSES, _STALE, _COMPILES, _LOADS, _PERSISTS):
         ctr.set(0)
+
+
+class stats_delta:
+    """``with program.stats_delta() as d: <trial>`` — on exit ``d``
+    holds the per-counter difference of :func:`cache_stats` across the
+    block.  The autotuner's trial-isolation primitive: a timed window
+    over a previously-seen config against a warm ``MXTPU_PROGRAM_CACHE``
+    must show ``d["compiles"] == 0`` (re-evaluation is compile-free —
+    loads and cache hits only), and the tune test asserts exactly that.
+    """
+
+    def __enter__(self) -> Dict[str, int]:
+        self._before = cache_stats()
+        self._d: Dict[str, int] = {}
+        return self._d
+
+    def __exit__(self, *exc):
+        after = cache_stats()
+        self._d.update({k: after[k] - self._before.get(k, 0)
+                        for k in after})
+        return False

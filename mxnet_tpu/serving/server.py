@@ -95,8 +95,10 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import _tsan
+from .. import envknobs as _envknobs
 from .. import faults as _faults
 from .. import obs as _obs
+from .. import tuneplan as _tuneplan
 from .compiled import CompiledForward, compiled_forward
 
 __all__ = ["ModelServer", "ServeFuture", "ServeTimeout", "ServeError",
@@ -258,11 +260,10 @@ class _Model:
 
 
 def _env_int(name, default):
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        raise MXNetError("%s=%r is not an integer"
-                         % (name, os.environ.get(name))) from None
+    # the registry's typed getter: same "%s=%r is not an integer"
+    # error shape, plus the knob is a declared name validate_environ
+    # can vouch for (docs/how_to/env_var.md)
+    return _envknobs.get_int(name, default)
 
 
 class ModelServer:
@@ -282,19 +283,36 @@ class ModelServer:
                  queue_cap: Optional[int] = None,
                  shed_policy: Optional[str] = None,
                  breaker_k: Optional[int] = None,
-                 breaker_cooldown_ms: Optional[int] = None):
+                 breaker_cooldown_ms: Optional[int] = None,
+                 plan=None):
+        # --- persisted autotune plan (docs/how_to/autotune.md):
+        # ``plan=`` (dict, path, or None -> MXTPU_TUNE_PLAN) supplies
+        # serving-knob DEFAULTS below explicit constructor args and
+        # set env vars — ctor > env > plan > default.  The key's
+        # mesh/jax/platform are checked here (foreign = counted loud
+        # fallback); the symbol digest is checked per tenant at
+        # add_model (the constructor has no symbol yet).
+        self.tune_plan = _tuneplan.resolve(plan)
+        splan = _tuneplan.serve_section(self.tune_plan, mesh=mesh)
+        self.plan_knobs = splan      # what actually applied
         if buckets is None:
-            buckets = [int(b) for b in os.environ.get(
-                "MXTPU_SERVE_BUCKETS", "1,4,8,16,32").split(",") if b]
+            if _envknobs.is_set("MXTPU_SERVE_BUCKETS"):
+                buckets = [int(b) for b in
+                           os.environ["MXTPU_SERVE_BUCKETS"].split(",")
+                           if b]
+            else:
+                buckets = splan.get("buckets", [1, 4, 8, 16, 32])
         self.buckets = sorted(set(int(b) for b in buckets))
         if not self.buckets or self.buckets[0] < 1:
             raise MXNetError("buckets must be positive ints, got %s"
                              % (buckets,))
-        self.max_wait_s = (max_wait_us if max_wait_us is not None
-                           else _env_int("MXTPU_SERVE_MAX_WAIT_US",
-                                         2000)) / 1e6
+        if max_wait_us is None:
+            max_wait_us = _env_int("MXTPU_SERVE_MAX_WAIT_US",
+                                   splan.get("max_wait_us", 2000))
+        self.max_wait_s = max_wait_us / 1e6
         self.cap = int(cap) if cap is not None \
-            else _env_int("MXTPU_SERVE_CAP", self.buckets[-1])
+            else _env_int("MXTPU_SERVE_CAP",
+                          splan.get("cap", self.buckets[-1]))
         timeout_ms = timeout_ms if timeout_ms is not None \
             else _env_int("MXTPU_SERVE_TIMEOUT_MS", 10000)
         self.timeout_s = (timeout_ms / 1e3) if timeout_ms else None
@@ -305,10 +323,12 @@ class ModelServer:
         # queue_cap (0 = unbounded, the pre-overload-story behavior);
         # past it submit() sheds per shed_policy
         self.queue_cap = int(queue_cap) if queue_cap is not None \
-            else _env_int("MXTPU_SERVE_QUEUE_CAP", 4096)
+            else _env_int("MXTPU_SERVE_QUEUE_CAP",
+                          splan.get("queue_cap", 4096))
         if shed_policy is None:
-            shed_policy = os.environ.get("MXTPU_SERVE_SHED_POLICY",
-                                         "reject")
+            shed_policy = _envknobs.get_str(
+                "MXTPU_SERVE_SHED_POLICY",
+                splan.get("shed_policy", "reject"))
         if shed_policy not in ("reject", "block"):
             raise MXNetError("shed_policy %r is not 'reject' or 'block'"
                              % (shed_policy,))
@@ -452,6 +472,14 @@ class ModelServer:
         dtypes = infer_input_dtypes(
             symbol, params, list(example_shapes) + label_names,
             declared=input_dtypes)
+
+        # advisory tenant check against the applied tune plan: the
+        # serve knobs were already set at construction, so a foreign
+        # symbol digest here is counted + logged, not reverted
+        if self.tune_plan is not None:
+            from ..program import symbol_digest as _sym_digest
+            _tuneplan.check_symbol(self.tune_plan, _sym_digest(symbol),
+                                   "model %r" % name)
 
         cf = compiled_forward(
             symbol, list(example_shapes) + label_names,

@@ -74,9 +74,9 @@ def build_model(network="mlp", seed=0):
         # enough that the CPU tier sweeps in seconds
         data = mx.sym.Variable("data")
         net = mx.symbol.FullyConnected(data, num_hidden=256, name="fc1")
-        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.Activation(net, act_type="relu", name="relu1")
         net = mx.symbol.FullyConnected(net, num_hidden=256, name="fc2")
-        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.Activation(net, act_type="relu", name="relu2")
         net = mx.symbol.FullyConnected(net, num_hidden=16, name="fc3")
         sym = mx.symbol.SoftmaxOutput(net, name="softmax")
         example = (64,)
@@ -100,9 +100,9 @@ def build_model(network="mlp", seed=0):
         # interpreter, not the telemetry
         data = mx.sym.Variable("data")
         net = mx.symbol.FullyConnected(data, num_hidden=512, name="fc1")
-        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.Activation(net, act_type="relu", name="relu1")
         net = mx.symbol.FullyConnected(net, num_hidden=512, name="fc2")
-        net = mx.symbol.Activation(net, act_type="relu")
+        net = mx.symbol.Activation(net, act_type="relu", name="relu2")
         net = mx.symbol.FullyConnected(net, num_hidden=16, name="fc3")
         sym = mx.symbol.SoftmaxOutput(net, name="softmax")
         example = (64,)
@@ -176,16 +176,27 @@ def _mixed_payloads(example, rows_mix, count, seed):
     return [rng.randn(int(s), *example).astype("f") for s in sizes]
 
 
-def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
-                      shed_exceptions=()):
-    """The shared open-loop arrival engine: a Poisson schedule fixed up
-    front and honored regardless of how far behind the server falls.
-    Submits shed with one of ``shed_exceptions`` are counted (and
-    timed) instead of raised.  Returns
-    ``(futures, rejected, reject_max_ms, submit_elapsed_s, t0)``."""
+def arrival_schedule(n, rate_rps, seed):
+    """A Poisson open-loop arrival schedule: ``n`` cumulative arrival
+    times at ``rate_rps``, SEEDED and reusable — the autotuner compares
+    two configs against the *identical* arrival sequence instead of two
+    random draws (numpy's ``exponential(scale)`` is ``scale *``
+    standard draws, so the same seed at any rate yields the same
+    sequence shape, just rescaled)."""
     rng = np.random.RandomState(seed)
-    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps,
-                                         size=len(payloads)))
+    return np.cumsum(rng.exponential(1.0 / rate_rps, size=n))
+
+
+def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
+                      shed_exceptions=(), arrivals=None):
+    """The shared open-loop arrival engine: a Poisson schedule fixed up
+    front (``arrivals`` — or drawn here from ``seed``) and honored
+    regardless of how far behind the server falls.  Submits shed with
+    one of ``shed_exceptions`` are counted (and timed) instead of
+    raised.  Returns
+    ``(futures, rejected, reject_max_ms, submit_elapsed_s, t0)``."""
+    if arrivals is None:
+        arrivals = arrival_schedule(len(payloads), rate_rps, seed)
     futures = []
     rejected, reject_max_ms = 0, 0.0
     t0 = time.perf_counter()
@@ -209,12 +220,14 @@ def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
             time.perf_counter() - t0, t0)
 
 
-def poisson_run(server, payloads, rate_rps, model=None, seed=2):
+def poisson_run(server, payloads, rate_rps, model=None, seed=2,
+                arrivals=None):
     """Open-loop Poisson arrivals at ``rate_rps`` requests/s (a shed —
     possible since queues are bounded by default — propagates: this
     sweep stays at loads the server keeps up with)."""
     futures, _, _, _, t0 = _open_loop_submit(server, payloads, rate_rps,
-                                             model=model, seed=seed)
+                                             model=model, seed=seed,
+                                             arrivals=arrivals)
     ok, failed, lat = 0, 0, []
     for f in futures:
         try:
@@ -244,7 +257,7 @@ def poisson_run(server, payloads, rate_rps, model=None, seed=2):
 
 
 def overload_run(server, payloads, rate_rps, deadline_s, model=None,
-                 seed=2):
+                 seed=2, arrivals=None):
     """Open-loop arrivals at ``rate_rps`` against a server with
     admission control on.  A submit the server sheds
     (:class:`ServeOverload` / :class:`ServeUnavailable`) counts as a
@@ -254,7 +267,7 @@ def overload_run(server, payloads, rate_rps, deadline_s, model=None,
 
     futures, rejected, reject_max_ms, submit_elapsed, t0 = \
         _open_loop_submit(server, payloads, rate_rps, model=model,
-                          seed=seed,
+                          seed=seed, arrivals=arrivals,
                           shed_exceptions=(ServeOverload,
                                            ServeUnavailable))
     good, late, failed, lat = 0, 0, 0, []

@@ -386,6 +386,132 @@ def analyze(hlo_text, hbm_gbps, mxu_tflops):
     return rows
 
 
+# ----------------------------------------------------------------------
+# the importable byte cost model (the autotuner's training surrogate
+# and bench.py's accounting share THIS code path — the CLI used to be
+# the only entry point, so the tuner would have had to shell out)
+def step_cost(trainer, batch_vals, lr=0.1):
+    """Compile the fused step for concrete batch values and return
+    XLA's aggregate cost-model accounting::
+
+        {"bytes", "flops", "gb_per_step", "tflop_per_step", "compiled"}
+
+    Pure trace+compile — nothing executes.  ``compiled`` is the
+    compiled step (``.as_text()`` feeds :func:`analyze`)."""
+    from tools.stepcost import compile_step, cost_analysis
+    comp = compile_step(trainer, batch_vals, lr=lr)
+    ca = cost_analysis(comp)
+    return {"bytes": ca["bytes"], "flops": ca["flops"],
+            "gb_per_step": ca["bytes"] / 1e9,
+            "tflop_per_step": ca["flops"] / 1e12,
+            "compiled": comp}
+
+
+# the knobs cost_model understands; a typo'd key is a loud error with
+# a did-you-mean (the envknobs/faults discipline — a surrogate that
+# silently ignored "grad_acum" would "tune" nothing)
+_COST_CONFIG_DEFAULTS = {
+    "model": "mlp", "batch": 16, "image": 64, "num_classes": None,
+    "devices": 1, "compute_dtype": None, "dtype_policy": None,
+    "remat": None, "zero": None, "grad_accum": None, "grad_dtype": None,
+}
+
+
+def cost_model(config=None, **overrides):
+    """``cost_model(config) -> {"gb_per_step", ...}`` — the importable
+    training-side surrogate: build the fused Trainer for ``config``,
+    compile (never execute) its step, and return the XLA cost-model
+    bytes/flops.  Config knobs: ``model`` (``mlp`` — CPU-tier seconds —
+    or ``resnet-50``), ``batch``, ``image`` (resnet), ``num_classes``,
+    ``devices`` (data-mesh degree over the local devices; >1 enables
+    the zero/grad_dtype corners), and the trainer knobs
+    ``compute_dtype``/``dtype_policy``/``remat``/``zero``/
+    ``grad_accum``/``grad_dtype``.
+
+    A repeated config against a warm ``MXTPU_PROGRAM_CACHE`` re-uses
+    the persisted executable, so the dominant cost — tracing — is paid
+    once per distinct config, ever (docs/how_to/compiled_programs.md).
+    """
+    cfg = dict(_COST_CONFIG_DEFAULTS)
+    given = dict(config or {}, **overrides)
+    unknown = sorted(set(given) - set(cfg))
+    if unknown:
+        import difflib
+        close = difflib.get_close_matches(unknown[0], sorted(cfg), n=1)
+        raise ValueError(
+            "unknown cost_model config key(s) %s%s — known: %s"
+            % (unknown, (" (did you mean %r?)" % close[0]) if close
+               else "", "/".join(sorted(cfg))))
+    cfg.update(given)
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.parallel.trainer import Trainer
+
+    batch = int(cfg["batch"])
+    if cfg["model"] == "mlp":
+        # THE tune workload — the same symbol serve_bench builds (and
+        # the one the emitted plan is keyed to), not a lookalike: a
+        # private copy here would fork the digest (and the program-
+        # cache keyspace) from the timed trials
+        from tools.serve_bench import build_model
+        if cfg["num_classes"] not in (None, 16):
+            raise ValueError("the mlp tune workload has a fixed "
+                             "16-class head (num_classes=%r)"
+                             % (cfg["num_classes"],))
+        ncls = 16
+        sym = build_model("mlp", 0)[0]
+        data_shape = (batch, 64)
+    elif cfg["model"] == "resnet-50":
+        from mxnet_tpu import models
+        ncls = int(cfg["num_classes"] or 1000)
+        sym = models.get_symbol("resnet-50", num_classes=ncls,
+                                layout="NHWC")
+        image = int(cfg["image"])
+        data_shape = (batch, image, image, 3)
+    else:
+        raise ValueError("unknown cost_model model %r (mlp|resnet-50)"
+                         % (cfg["model"],))
+
+    mesh = None
+    n = int(cfg["devices"])
+    if n > 1:
+        devices = jax.devices()
+        if len(devices) < n:
+            raise RuntimeError(
+                "cost_model config wants a %d-way data mesh but only "
+                "%d local devices exist" % (n, len(devices)))
+        mesh = parallel.make_mesh({"data": n}, devices[:n])
+
+    t = Trainer(sym, mx.optimizer.create(
+        "sgd", learning_rate=0.1, momentum=0.9,
+        rescale_grad=1.0 / batch),
+        mesh=mesh, compute_dtype=cfg["compute_dtype"],
+        dtype_policy=cfg["dtype_policy"], remat=cfg["remat"],
+        zero=cfg["zero"], grad_accum=cfg["grad_accum"],
+        grad_dtype=cfg["grad_dtype"])
+    t.bind(data_shapes={"data": data_shape},
+           label_shapes={"softmax_label": (batch,)})
+    mx.random.seed(3)
+    t.init_params(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    batch_vals = {
+        "data": jnp.asarray(rng.normal(0, 1, data_shape)
+                            .astype(np.float32)),
+        "softmax_label": jnp.asarray(
+            rng.randint(0, ncls, (batch,)).astype(np.float32))}
+    sc = step_cost(t, batch_vals)
+    return {"gb_per_step": round(sc["gb_per_step"], 6),
+            "tflop_per_step": round(sc["tflop_per_step"], 6),
+            "bytes": sc["bytes"], "flops": sc["flops"],
+            "opt_state_bytes_per_chip": t.opt_state_bytes_per_chip(),
+            "grad_comm_gb_per_step": round(
+                t.grad_comm_bytes_per_step() / 1e9, 6),
+            "config": {k: v for k, v in cfg.items()}}
+
+
 # the byte-attack history, kept with the artifact so a regeneration
 # never drops the record the numbers rest on
 _ATTACK_HISTORY = {
@@ -461,17 +587,15 @@ def capture(batch=256, image=224, measure=True, steps=40, ctx=None):
                                          "rescale_grad": 1.0 / batch})
     t = mod._trainer
 
-    from tools.stepcost import (compile_step, cost_analysis,
-                                timed_module_steps)
+    from tools.stepcost import timed_module_steps
     rng = np.random.RandomState(0)
     batch_vals = {
         "data": jnp.asarray(rng.normal(
             0, 1, (batch, image, image, 3)).astype(np.float32)),
         "softmax_label": jnp.asarray(
             rng.randint(0, 1000, (batch,)).astype(np.float32))}
-    comp = compile_step(t, batch_vals)
-    ca = cost_analysis(comp)
-    hlo = comp.as_text()
+    sc = step_cost(t, batch_vals)
+    hlo = sc["compiled"].as_text()
 
     roof = json.load(open(os.path.join(ROOT, "ROOFLINE.json")))
     rows = analyze(hlo, roof["hbm_gbps"], roof["bf16_matmul_tflops"])
@@ -498,8 +622,8 @@ def capture(batch=256, image=224, measure=True, steps=40, ctx=None):
             measured_ms / total_roofline_ms, 3)
         if (measured_ms and total_roofline_ms) else None,
         "hlo_walk_gb_per_step": round(total_gb, 2),
-        "cost_model_gb_per_step": round(ca["bytes"] / 1e9, 2),
-        "cost_model_tflop_per_step": round(ca["flops"] / 1e12, 3),
+        "cost_model_gb_per_step": round(sc["gb_per_step"], 2),
+        "cost_model_tflop_per_step": round(sc["tflop_per_step"], 3),
         "n_instructions": len(rows),
         "top": rows[:25],
         "layers": layer_table(rows),
