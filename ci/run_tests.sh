@@ -169,6 +169,31 @@ timeout -k 10 180 env JAX_PLATFORMS=cpu \
     python tools/autotune.py --verify "$TUNE_TMP/TUNE_PLAN.json"
 rm -rf "$TUNE_TMP"
 
+stage "int8 quantization gate (calibrate -> accuracy gate -> serve)"
+# the calibrated-quantization workflow end to end on the planted ranker
+# demo (no training loop): float forward calibration, the argmax
+# agreement / top-1 accuracy gate, quantized checkpoint emission with
+# the calibration digest stamped in the manifest, then a reload through
+# latest_verified() + Predictor + an int8-tier ModelServer with
+# predictor-vs-server agreement asserted.  The tool exits 3 (stage
+# FAILS) if the gate refuses or the served tier mismatches —
+# docs/how_to/quantization.md.  HARD timeout: a wedged serve check must
+# fail this stage, not hang the gate.
+QUANT_TMP="$(mktemp -d)"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python tools/quantize.py --demo ranker --serve \
+        --out-dir "$QUANT_TMP"
+rm -rf "$QUANT_TMP"
+
+stage "quantization suite (calibration / gate refusal / int8 storage)"
+# calibration determinism + digest provenance, the gate's clipped-
+# calibration refusal, quantized-checkpoint verified reload, 1-byte-
+# per-elem device storage on both serve surfaces, precision-tier
+# admission, plan licensing, and the dequant-unfused jaxpr pass.
+# HARD timeout: a hung serve-surface test must fail, not wedge CI.
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_quant_calibration.py -q
+
 stage "comm lint gate (static collective-communication analysis)"
 # extracts the comm plan (collective, axis, dtype, predicted wire
 # bytes, layer provenance) of the fused ZeRO-1+bf16 trainer step, the
@@ -212,7 +237,8 @@ timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 MXTPU_OBS=1 \
     MXTPU_TSAN_LOG="$TSAN_LOG" \
     python -m pytest tests/test_serving.py tests/test_serving_overload.py \
         tests/test_stream_pipeline.py tests/test_obs.py \
-        tests/test_elastic.py tests/test_integrity.py -q -m "not slow"
+        tests/test_elastic.py tests/test_integrity.py \
+        tests/test_quant_calibration.py -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"
 
@@ -285,13 +311,15 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_elastic.py, test_integrity.py, test_obs.py, test_resilience.py,
-# test_serving.py, test_serving_overload.py, test_stream_pipeline.py
-# and test_zero_accum.py already ran as their own stages above
+# test_elastic.py, test_integrity.py, test_obs.py,
+# test_quant_calibration.py, test_resilience.py, test_serving.py,
+# test_serving_overload.py, test_stream_pipeline.py and
+# test_zero_accum.py already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_elastic.py \
     --ignore=tests/test_integrity.py \
     --ignore=tests/test_obs.py \
+    --ignore=tests/test_quant_calibration.py \
     --ignore=tests/test_resilience.py \
     --ignore=tests/test_serving.py \
     --ignore=tests/test_serving_overload.py \

@@ -110,6 +110,30 @@ P100_BATCH32 = {"alexnet": 4883.77, "vgg": 854.4, "inception-bn": 1197.74,
                 "resnet-152": 294.17}
 
 
+def stamp_vs_f32(rows):
+    """Stamp every non-f32 row with its speedup over the float32 row at
+    the same (network, batch); int8 rows that LOSE get an explicit
+    ``quant_regression`` flag.  Quantization is a bandwidth trade — at
+    batch 1 the weight-traffic saving can't cover the dequant work
+    (alexnet b1 serves 827 int8 vs 907 f32), while at batch 32 the
+    reuse flips it (docs/how_to/perf.md "batch-size crossover") — so
+    the artifact must say per row whether the trade paid off, not leave
+    readers to cross-divide."""
+    f32 = {(r["network"], r["batch_size"]): r["img_per_sec"]
+           for r in rows if r["dtype"] == "float32"}
+    for r in rows:
+        base = f32.get((r["network"], r["batch_size"]))
+        if r["dtype"] == "float32" or not base:
+            continue
+        r["vs_f32"] = round(r["img_per_sec"] / base, 3)
+        if r["dtype"] == "int8":
+            if r["vs_f32"] < 1.0:
+                r["quant_regression"] = True
+            else:
+                r.pop("quant_regression", None)
+    return rows
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(description="score the model zoo")
     parser.add_argument("--networks", type=str,
@@ -143,6 +167,7 @@ def main(argv=None):
                     row["p100_img_per_sec"] = P100_BATCH32[net]
                     row["vs_p100"] = round(speed / P100_BATCH32[net], 2)
                 rows.append(row)
+    stamp_vs_f32(rows)
     if args.out:
         import json
         import jax

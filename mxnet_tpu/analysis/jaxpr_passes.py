@@ -22,7 +22,8 @@ from .core import (ERROR, INFO, WARN, Finding, GraphPass, PassContext,
 __all__ = ["iter_eqns", "iter_eqns_scoped", "layer_of_eqn",
            "F64WideningPass",
            "HostCallbackPass", "DonationPass", "GatherScatterPass",
-           "ReplicatedOptStatePass", "ServeShapeBucketPass"]
+           "ReplicatedOptStatePass", "ServeShapeBucketPass",
+           "DequantUnfusedPass"]
 
 _SCOPE_RE = re.compile(r"^(transpose\()?(?:jvp\()?([A-Za-z0-9_.\-]+?)\)*$")
 
@@ -453,3 +454,120 @@ class ServeShapeBucketPass(GraphPass):
                 "rows" % (hits, off, sorted(bset)),
                 detail={"off_bucket_sizes": off, "buckets": sorted(bset)}))
         return out
+
+
+_DQ_NARROW = ("int8", "uint8")
+_DQ_WIDE = ("float32", "bfloat16", "float16")
+# elementwise/layout prims a dequant chain may pass through and still
+# fuse into its consumer (the scale multiply + broadcast + reshape of
+# contrib.quantization's dequant subgraph)
+_DQ_CHAIN = ("mul", "broadcast_in_dim", "reshape", "convert_element_type",
+             "transpose", "squeeze")
+# call-like prims: crossing one forces the operand to materialize as a
+# buffer at the call boundary (XLA does not fuse across these)
+_DQ_CALLS = ("pjit", "xla_call", "closed_call", "core_call", "scan",
+             "while", "cond", "shard_map", "custom_jvp_call",
+             "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+             "remat2", "checkpoint")
+
+
+@register_pass
+class DequantUnfusedPass(GraphPass):
+    """Dequantized weights materialized outside their consumer's fusion.
+
+    The whole premise of int8 serving is that weights live in device
+    memory at 1 byte/elem and widen to the compute dtype INSIDE the
+    consuming matmul/conv fusion — registers, not HBM.  A dequantized
+    f32/bf16 copy that escapes the fusion (returned as a program
+    output, or forced through a call boundary like pjit/scan, which XLA
+    never fuses across) silently re-materializes the full-width weight
+    every step: the HBM traffic AND footprint win are both gone while
+    the checkpoint still *looks* quantized.  Error on any int8->float
+    ``convert_element_type`` of at least ``dequant_min_bytes`` (default
+    1 MiB) whose dequant chain (scale mul / broadcast / reshape, up to
+    3 hops) ends anywhere but a fusible consumer.  A dequant feeding
+    SEVERAL dot/conv consumers is fine — XLA duplicates the cheap
+    widen-multiply into each fusion rather than materializing it.
+    """
+
+    name = "dequant-unfused"
+    level = "jaxpr"
+
+    def run(self, ctx: PassContext):
+        if ctx.jaxpr is None:
+            return []
+        min_bytes = int(ctx.config.get("dequant_min_bytes", 1 << 20))
+        out: List[Finding] = []
+        self._scan(getattr(ctx.jaxpr, "jaxpr", ctx.jaxpr), "",
+                   min_bytes, out)
+        return out
+
+    # each jaxpr scope is scanned independently: vars are scope-local,
+    # and escaping a sub-jaxpr's outvars is a materialization at that
+    # call boundary just like escaping the top-level program
+    def _scan(self, jx, prefix, min_bytes, out):
+        jx = getattr(jx, "jaxpr", jx)
+        consumers = {}
+        for eqn in jx.eqns:
+            for v in eqn.invars:
+                if not hasattr(v, "val"):       # skip Literals
+                    consumers.setdefault(id(v), []).append(eqn)
+        outvar_ids = {id(v) for v in jx.outvars}
+
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "convert_element_type":
+                self._check(eqn, consumers, outvar_ids, prefix,
+                            min_bytes, out)
+            for sub in _sub_jaxprs(eqn):
+                stack = _eqn_stack(eqn)
+                sub_prefix = ("%s/%s" % (prefix, stack)
+                              if prefix and stack else (stack or prefix))
+                self._scan(sub, sub_prefix, min_bytes, out)
+
+    def _check(self, eqn, consumers, outvar_ids, prefix, min_bytes, out):
+        src = eqn.invars[0]
+        if hasattr(src, "val") or not hasattr(src, "aval"):
+            return
+        sdt = str(getattr(src.aval, "dtype", ""))
+        odt = str(eqn.outvars[0].aval.dtype)
+        if sdt not in _DQ_NARROW or odt not in _DQ_WIDE:
+            return
+        aval = eqn.outvars[0].aval
+        nbytes = int(np.prod(aval.shape or (1,))) * aval.dtype.itemsize
+        if nbytes < min_bytes:
+            return
+        reason = self._chase(eqn.outvars[0], consumers, outvar_ids, 3)
+        if reason is None:
+            return
+        layer, where = _where(eqn, prefix)
+        out.append(Finding(
+            self.name, ERROR, where, "convert_element_type",
+            "%.1f MB %s weight dequantized to %s and %s — the widened "
+            "copy materializes in HBM instead of fusing into its "
+            "consumer, forfeiting the int8 footprint and bandwidth win"
+            % (nbytes / 1e6, sdt, odt, reason),
+            layer=layer,
+            detail={"bytes": nbytes, "shape": tuple(aval.shape),
+                    "from": sdt, "to": odt, "reason": reason}))
+
+    def _chase(self, var, consumers, outvar_ids, hops):
+        """Follow the dequant chain; return why it materializes, or
+        None when every path ends in a fusible consumer."""
+        if id(var) in outvar_ids:
+            return "returned as a program output"
+        for user in consumers.get(id(var), ()):
+            pname = user.primitive.name
+            if pname in _DQ_CALLS:
+                return "passed into %r (a call boundary XLA cannot " \
+                       "fuse across)" % pname
+            if pname in _DQ_CHAIN:
+                if hops <= 0:
+                    return "still unconsumed after the dequant chain " \
+                           "(%r)" % pname
+                reason = self._chase(user.outvars[0], consumers,
+                                     outvar_ids, hops - 1)
+                if reason is not None:
+                    return reason
+            # anything else (dot_general, conv, add, ...) fuses the
+            # cheap widen in place of a materialized operand
+        return None

@@ -137,6 +137,18 @@ KNOBS: Dict[str, _Knob] = dict((
        "stop() drain budget for queued work"),
     _k("MXTPU_SERVE_SLOW_S", "float", 0.05, "serving",
        "injected slow_request stall"),
+    _k("MXTPU_SERVE_PRECISION", "str", "auto", "serving",
+       "tenant precision tier: auto|float32|bfloat16|int8 "
+       "(int8 requires a quantized symbol; see quantization.md)"),
+    # --- quantization --------------------------------------------------
+    _k("MXTPU_QUANT_MODE", "str", "minmax", "quant",
+       "activation calibration mode: minmax|percentile"),
+    _k("MXTPU_QUANT_PERCENTILE", "float", 99.9, "quant",
+       "percentile of |x| per calibration batch (percentile mode)"),
+    _k("MXTPU_QUANT_MIN_AGREEMENT", "float", 0.99, "quant",
+       "accuracy gate: min argmax agreement vs f32 on holdout"),
+    _k("MXTPU_QUANT_MAX_TOP1_DELTA", "float", 0.5, "quant",
+       "accuracy gate: max top-1 accuracy drop vs f32, in points"),
     # --- compiled programs --------------------------------------------
     _k("MXTPU_PROGRAM_CACHE", "str", None, "program",
        "persisted compiled-program cache dir"),

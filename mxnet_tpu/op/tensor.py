@@ -394,8 +394,22 @@ def _embedding_infer_shape(p, in_shapes):
     return [dshape, wshape], [tuple(dshape) + (p["output_dim"],)], []
 
 
+def _embedding_infer_dtype(p, in_dtypes):
+    # the generic rule backfills unknown input dtypes from the first
+    # KNOWN one — for Embedding that let declared int32 ids leak into
+    # the WEIGHT dtype, silently truncating the table at bind time.
+    # ids and table dtypes are independent: ids default int32, table
+    # defaults to the ``dtype`` param, and the gather's output dtype is
+    # the TABLE dtype (an int8 table gathers int8 rows — the quantized
+    # serving path dequantizes after the gather).
+    ddt = in_dtypes[0] if in_dtypes[0] is not None else np.dtype(np.int32)
+    wdt = in_dtypes[1] if in_dtypes[1] is not None else np.dtype(p["dtype"])
+    return [ddt, wdt], [wdt], []
+
+
 from . import registry as _r
 _r.get("Embedding").infer_shape = _embedding_infer_shape
+_r.get("Embedding").infer_dtype = _embedding_infer_dtype
 
 
 @register("pick", params_spec=(_axis_param("axis", -1), Param("keepdims", bool, False)),

@@ -171,9 +171,16 @@ class CheckpointManager:
         return retry_io(fn, attempts=self.attempts, delay=self.delay,
                         what=what, logger=self.logger)
 
-    def save(self, module, epoch: int, arg_params=None, aux_params=None):
+    def save(self, module, epoch: int, arg_params=None, aux_params=None,
+             extra_manifest=None):
         """Checkpoint ``module`` as epoch ``epoch`` (1-based: the number
-        of COMPLETED epochs, matching ``callback.do_checkpoint``)."""
+        of COMPLETED epochs, matching ``callback.do_checkpoint``).
+
+        ``extra_manifest``: JSON-serializable dict merged into the
+        manifest under its own keys (reserved core keys win).  Used by
+        tools/quantize.py to stamp the quantization config + calibration
+        digest onto a quantized checkpoint — provenance rides the same
+        verified commit record as the weights."""
         from .model import save_checkpoint
         if arg_params is None or aux_params is None:
             arg_params, aux_params = module.get_params()
@@ -229,7 +236,8 @@ class CheckpointManager:
                     "checkpoint %04d: state fingerprint unavailable "
                     "(%s) — save still CRC-manifested, but it cannot "
                     "pass latest_verified()", epoch, e)
-        manifest = {
+        manifest = dict(extra_manifest or {})
+        manifest.update({
             "version": _MANIFEST_VERSION,
             "epoch": int(epoch),
             "step": int(trainer.num_update) if trainer is not None
@@ -250,7 +258,7 @@ class CheckpointManager:
             "wallclock": time.time(),
             "files": files,
             "integrity": integ,
-        }
+        })
         self._retry(lambda: self._write_manifest(epoch, manifest),
                     "manifest write")
         self._prune()

@@ -132,11 +132,18 @@ class CompiledForward(CompiledProgram):
                                   key, False)
             return outs
 
+        # the quantization tier rides the program key: a quantized and
+        # a float symbol already differ in digest, but the explicit tag
+        # keeps the persisted-cache ident honest if two graphs ever
+        # collide structurally — cached executables can never cross
+        # precision tiers (docs/how_to/quantization.md)
+        from ..contrib.quantization import quant_tag
         super().__init__(
             "serving.forward", _fwd,
             key={"symbol": _symbol_digest(symbol),
                  "inputs": tuple(sorted(self.input_names)),
-                 "platform": platform, "dtype_policy": dtype_policy})
+                 "platform": platform, "dtype_policy": dtype_policy,
+                 "quant": quant_tag(symbol)})
 
     # ------------------------------------------------------------------
     def _on_trace(self, args, lazy: bool) -> None:

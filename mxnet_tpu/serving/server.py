@@ -227,7 +227,8 @@ class _Model:
     __slots__ = ("name", "symbol", "cf", "params", "aux", "example_shapes",
                  "label_trailing", "input_dtypes", "queue", "pending",
                  "n_outputs", "breaker", "consec_failures", "opened_at",
-                 "batches", "sheds_since_batch", "lat_hist")
+                 "batches", "sheds_since_batch", "lat_hist",
+                 "weight_bytes_on_device", "quant")
 
     def __init__(self, name, symbol, cf, params, aux, example_shapes,
                  label_trailing, input_dtypes, n_outputs):
@@ -284,6 +285,7 @@ class ModelServer:
                  shed_policy: Optional[str] = None,
                  breaker_k: Optional[int] = None,
                  breaker_cooldown_ms: Optional[int] = None,
+                 precision: Optional[str] = None,
                  plan=None):
         # --- persisted autotune plan (docs/how_to/autotune.md):
         # ``plan=`` (dict, path, or None -> MXTPU_TUNE_PLAN) supplies
@@ -340,6 +342,18 @@ class ModelServer:
         self.breaker_cooldown_s = (
             breaker_cooldown_ms if breaker_cooldown_ms is not None
             else _env_int("MXTPU_SERVE_BREAKER_COOLDOWN_MS", 1000)) / 1e3
+        # precision tier contract: "auto" admits anything; "int8"
+        # requires every tenant symbol to be quantized (quant_tag !=
+        # none); "float32"/"bfloat16" reject quantized tenants.  The
+        # autotune plan may only carry precision="int8" when the
+        # accuracy gate passed (tools/quantize.py; docs quantization.md)
+        if precision is None:
+            precision = _envknobs.get_str(
+                "MXTPU_SERVE_PRECISION", splan.get("precision", "auto"))
+        if precision not in ("auto", "float32", "bfloat16", "int8"):
+            raise MXNetError("precision %r is not auto|float32|bfloat16"
+                             "|int8" % (precision,))
+        self.precision = precision
         self.mesh = mesh
         self._data_axis = 1
         if mesh is not None:
@@ -481,12 +495,34 @@ class ModelServer:
             _tuneplan.check_symbol(self.tune_plan, _sym_digest(symbol),
                                    "model %r" % name)
 
+        # precision-tier admission: the knob is only as real as its
+        # enforcement — a plan that says int8 must not silently serve a
+        # float checkpoint (and vice versa)
+        from ..contrib.quantization import quant_tag
+        tag = quant_tag(symbol)
+        if self.precision == "int8" and tag == "none":
+            raise MXNetError(
+                "server precision tier is int8 but model %r is not "
+                "quantized (run tools/quantize.py first)" % name)
+        if self.precision in ("float32", "bfloat16") and tag != "none":
+            raise MXNetError(
+                "server precision tier is %s but model %r carries a "
+                "quantized symbol (%s)" % (self.precision, name, tag))
+
         cf = compiled_forward(
             symbol, list(example_shapes) + label_names,
             platform=self._platform(params))
         m = _Model(
             name, symbol, cf, params, aux, example_shapes, label_trailing,
             dtypes, len(symbol.list_outputs()))
+        # device bytes actually held by this tenant's weights — int8
+        # tables report 1 byte/elem here; a post-bind upcast would show
+        # up as a 4x jump in stats() (the regression this field exists
+        # to catch)
+        m.weight_bytes_on_device = int(
+            sum(int(v.nbytes) for v in params.values())
+            + sum(int(v.nbytes) for v in aux.values()))
+        m.quant = tag
         # per-model completed-request latency histogram (fixed buckets;
         # stats() reports p50/p95/p99 beside the EWMA — a histogram
         # survives the burst the EWMA smooths away)
@@ -1180,6 +1216,8 @@ class ModelServer:
                     "breaker_state": m.breaker,
                     "consec_failures": m.consec_failures,
                     "batches": m.batches,
+                    "weight_bytes_on_device": m.weight_bytes_on_device,
+                    "quant": m.quant,
                 }
         # the latency EWMA lives under each CompiledForward's own lock;
         # read it AFTER releasing _cond (never nest the two) — same for
@@ -1205,7 +1243,8 @@ class ModelServer:
                        "shed_policy": self.shed_policy,
                        "breaker_k": self.breaker_k,
                        "breaker_cooldown_ms": round(
-                           self.breaker_cooldown_s * 1e3, 1)}
+                           self.breaker_cooldown_s * 1e3, 1),
+                       "precision": self.precision}
         s["buckets"] = list(self.buckets)
         # this server's namespace in the process-wide metrics registry
         # (obs.snapshot() — the surface a fleet router scrapes)

@@ -72,6 +72,11 @@ TRAIN_KNOBS: Dict[str, tuple] = {
 SERVE_KNOBS: Dict[str, tuple] = {
     "buckets": (list,), "max_wait_us": (int,), "cap": (int,),
     "queue_cap": (int,), "shed_policy": (str,),
+    # consumed by ModelServer's precision-tier admission (server.py):
+    # autotune may only emit "int8" here when the tools/quantize.py
+    # accuracy gate passed for the plan's symbol (gate artifact digest
+    # recorded in plan meta) — docs/how_to/quantization.md
+    "precision": (str,),
 }
 
 _APPLIED = _obs.counter("tune.plan_applied")

@@ -1,0 +1,446 @@
+"""Calibrated int8 quantization end to end: calibration determinism,
+the accuracy gate (including its refusal), quantized checkpoints
+through the resilience layer, true 1-byte device storage on both serve
+surfaces, the precision tier policy, and the dequant-unfused jaxpr
+lint pass."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import serving
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.program import symbol_digest
+
+
+# ----------------------------------------------------------------------
+# shared small models
+def _ranker(vocab=2000, dim=32, slots=8, classes=8, seed=0):
+    """Tiny embedding ranker with planted class structure (the
+    tools/quantize.py demo recipe at test scale)."""
+    from tools.quantize import demo_ranker
+    return demo_ranker(seed=seed, vocab=vocab, dim=dim, slots=slots,
+                       classes=classes, n_holdout=128, hidden=32)
+
+
+def _threshold_mlp(seed=0, n=256):
+    """Magnitude-coded 4-class MLP with analytically PLANTED weights:
+    class k holds when mean(x) falls in band k, read out through relu
+    threshold units (h_j = relu(mean(x) - t_j)).  All class information
+    lives in activation SCALE, so a range-clipped calibration (scales
+    fit to data far smaller than serving data) saturates the thresholds
+    and collapses the argmax — a deterministic gate refusal with no
+    training loop."""
+    rng = np.random.RandomState(seed)
+    base = np.abs(rng.normal(0, 1, 16)).astype("f")
+    base /= base.mean()                      # mean(x) == class magnitude
+    mags = np.array([0.6, 1.1, 1.6, 2.1], "f")
+    y = rng.randint(0, 4, n)
+    x = (mags[y][:, None] * base[None, :]
+         + 0.05 * rng.normal(0, 1, (n, 16))).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, num_hidden=3, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    a = 4.0
+    args = {
+        # h_j = mean(x) - t_j with band edges between the magnitudes
+        "fc1_weight": mx.nd.array(np.full((3, 16), 1.0 / 16.0, "f")),
+        "fc1_bias": mx.nd.array(np.array([-0.85, -1.35, -1.85], "f")),
+        # bump readout: logit_k peaks in band k
+        "fc2_weight": mx.nd.array(np.array(
+            [[-a, 0, 0], [a, -2 * a, 0], [0, 2 * a, -4 * a],
+             [0, 0, 4 * a]], "f")),
+        "fc2_bias": mx.nd.array(np.array([0.05, 0, 0, 0], "f")),
+    }
+    return net, args, {}, x, y
+
+
+def _calibrate(demo, **kw):
+    it = mx.io.NDArrayIter({"ids": demo["calib"]["ids"]}, None, 64)
+    return q.calibrate_model(demo["sym"], demo["args"], demo["aux"],
+                             calib_iter=it, **kw)
+
+
+# ----------------------------------------------------------------------
+# calibration determinism
+def test_calibration_deterministic():
+    """Same data, same model -> bit-identical scales, identical
+    quantized symbol digest, identical calibration digest."""
+    demo = _ranker()
+    a = _calibrate(demo)
+    b = _calibrate(demo)
+    qsym_a, qargs_a, _, cal_a = a
+    qsym_b, qargs_b, _, cal_b = b
+    assert symbol_digest(qsym_a) == symbol_digest(qsym_b)
+    assert cal_a.digest == cal_b.digest
+    for k in qargs_a:
+        assert np.array_equal(qargs_a[k].asnumpy(),
+                              qargs_b[k].asnumpy()), k
+
+
+def test_calibration_digest_distinguishes_models():
+    """The digest pins WHAT was calibrated: two different models under
+    the same config must not collide (weights-only calibrations have
+    no activation ranges, so the symbol + weight-scale fingerprints
+    are what separates them)."""
+    cal_a = _calibrate(_ranker(seed=0),
+                       quantize_op_names=("Embedding",))[3]
+    cal_b = _calibrate(_ranker(seed=3),
+                       quantize_op_names=("Embedding",))[3]
+    assert cal_a.digest != cal_b.digest
+
+
+def test_calibration_modes_and_report():
+    """percentile mode trims outliers (scale <= minmax scale); the
+    emission report names every quantized tensor and every op kept
+    float with a reason."""
+    demo = _ranker()
+    _, _, _, mm = _calibrate(demo, calib_mode="minmax")
+    _, _, _, pc = _calibrate(demo, calib_mode="percentile",
+                             percentile=99.0)
+    assert mm.config["calib_mode"] == "minmax"
+    assert pc.config["calib_mode"] == "percentile"
+    for name in mm.act_scales:
+        assert pc.act_scales[name] <= mm.act_scales[name] + 1e-12
+    rules = {f.rule for f in mm.report.findings}
+    assert "quant-weight" in rules
+    assert "quant-keep-float" in rules   # the softmax head stays float
+
+
+# ----------------------------------------------------------------------
+# the accuracy gate
+def test_gate_passes_clean_and_refuses_clipped_calibration():
+    """The gate contract: a faithful calibration passes; a range-
+    clipped one (calibration data scaled far below serving data, so
+    activations saturate at serve time) is REFUSED."""
+    from tools.quantize import evaluate_gate, score
+    sym, arg_p, aux_p, x, y = _threshold_mlp()
+
+    def gate_for(calib_x):
+        it = mx.io.NDArrayIter({"data": calib_x}, None, 64)
+        qsym, qargs, qaux, _ = q.calibrate_model(
+            sym, arg_p, aux_p, calib_iter=it, min_elems=1)
+        ref = score(sym, arg_p, aux_p, {"data": x}, ("data",), 64)
+        got = score(qsym, qargs, qaux, {"data": x}, ("data",), 64)
+        return evaluate_gate(ref, got, y, 0.99, 0.5)
+
+    clean = gate_for(x)
+    clipped = gate_for((x * 0.02).astype("f"))
+    assert clean["passed"], clean
+    assert not clipped["passed"], clipped
+    assert clipped["argmax_agreement"] < clean["argmax_agreement"]
+
+
+def test_quantize_cli_check_exit_codes(tmp_path):
+    """tools/quantize.py --check enforces the gate: exit 0 on a clean
+    ranker, exit 3 (and NO checkpoint) when emission is refused."""
+    from tools.quantize import main
+    assert main(["--demo", "ranker", "--check"]) == 0
+    out = str(tmp_path / "refused")
+    rc = main(["--demo", "convnet", "--clip-calib", "0.02",
+               "--out-dir", out])
+    assert rc == 3
+    assert os.path.exists(os.path.join(out, "QUANT_GATE.json"))
+    assert not any(f.endswith(".params") for f in os.listdir(out))
+    gate = json.load(open(os.path.join(out, "QUANT_GATE.json")))
+    assert gate["passed"] is False
+
+
+# ----------------------------------------------------------------------
+# quantized checkpoints through the resilience layer
+def test_quantized_checkpoint_reload_keeps_fingerprint(tmp_path):
+    """Kill-and-reload: the emitted quantized checkpoint round-trips
+    through latest_verified() with its fingerprint intact, and the
+    manifest carries the quantization config + calibration digest."""
+    from mxnet_tpu.resilience import CheckpointManager
+    from tools.quantize import emit_checkpoint
+    demo = _ranker()
+    qsym, qargs, qaux, cal = _calibrate(demo)
+    prefix = str(tmp_path / "quantized")
+    gate = {"passed": True, "argmax_agreement": 1.0}
+    emit_checkpoint(prefix, 1, qsym, qargs, qaux, gate, cal)
+
+    # a FRESH manager (the restarted process)
+    ck = CheckpointManager(prefix).latest_verified()
+    assert ck is not None and ck.epoch == 1
+    meta = ck.manifest["quantization"]
+    assert meta["calibration_digest"] == cal.digest
+    assert meta["config"]["quantized_dtype"] == "int8"
+    rsym, rargs, _ = ck.load_params()
+    assert symbol_digest(rsym) == symbol_digest(qsym)
+    assert str(rargs["embed_weight_quant"].dtype) == "int8"
+    assert np.array_equal(rargs["embed_weight_quant"].asnumpy(),
+                          qargs["embed_weight_quant"].asnumpy())
+
+
+def test_quantized_checkpoint_corruption_detected(tmp_path):
+    """A flipped byte in the int8 table fails verification: the
+    fingerprint covers quantized storage exactly like float params."""
+    from mxnet_tpu.resilience import CheckpointManager
+    from tools.quantize import emit_checkpoint
+    demo = _ranker()
+    qsym, qargs, qaux, cal = _calibrate(demo)
+    prefix = str(tmp_path / "quantized")
+    emit_checkpoint(prefix, 1, qsym, qargs, qaux, {"passed": True}, cal)
+    path = "%s-0001.params" % prefix
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(blob))
+    assert CheckpointManager(prefix).latest_verified() is None
+
+
+# ----------------------------------------------------------------------
+# 1-byte device storage on both serve surfaces
+def test_int8_binds_one_byte_per_elem_predictor(tmp_path):
+    from mxnet_tpu.predictor import Predictor
+    from tools.quantize import emit_checkpoint
+    demo = _ranker()
+    qsym, qargs, qaux, cal = _calibrate(demo,
+                                        quantize_op_names=("Embedding",))
+    prefix = str(tmp_path / "q")
+    emit_checkpoint(prefix, 1, qsym, qargs, qaux, {"passed": True}, cal)
+    pred = Predictor.from_checkpoint(prefix, 1, {"ids": (4, 8)})
+    table = pred._params["embed_weight_quant"]
+    assert str(table.dtype) == "int8"
+    assert int(table.nbytes) == int(np.prod(table.shape))  # 1 B/elem
+    ids = np.zeros((4, 8), np.int32)
+    pred.set_input("ids", ids)
+    pred.forward()
+    assert pred.get_output(0).shape == (4, 8)
+
+
+def test_int8_binds_one_byte_per_elem_server_stats():
+    demo = _ranker()
+    qsym, qargs, qaux, _ = _calibrate(demo)
+    srv = serving.ModelServer(buckets=[1, 4], max_wait_us=100,
+                              precision="int8")
+    srv.add_model("r", qsym, qargs, qaux, input_shapes={"ids": (8,)})
+    with srv:
+        srv.predict(ids=np.zeros((4, 8), np.int32))
+        st = srv.stats()
+    pm = st["per_model"]["r"]
+    expected = sum(int(v.asnumpy().nbytes) for v in qargs.values()) \
+        + sum(int(v.asnumpy().nbytes) for v in qaux.values())
+    assert pm["weight_bytes_on_device"] == expected
+    assert json.loads(pm["quant"])["dtype"] == "int8"
+    # the quantized table really is 4x smaller than its float original
+    f32_bytes = demo["args"]["embed_weight"].asnumpy().nbytes
+    q_bytes = qargs["embed_weight_quant"].asnumpy().nbytes
+    assert q_bytes * 4 == f32_bytes
+
+
+# ----------------------------------------------------------------------
+# precision tier policy
+def test_precision_tier_rejects_mismatched_models(monkeypatch):
+    demo = _ranker()
+    qsym, qargs, qaux, _ = _calibrate(demo)
+
+    srv = serving.ModelServer(buckets=[1], precision="int8")
+    with pytest.raises(MXNetError, match="not quantized"):
+        srv.add_model("f", demo["sym"], demo["args"], demo["aux"],
+                      input_shapes={"ids": (8,)})
+
+    srv = serving.ModelServer(buckets=[1], precision="float32")
+    with pytest.raises(MXNetError, match="quantized"):
+        srv.add_model("q", qsym, qargs, qaux,
+                      input_shapes={"ids": (8,)})
+
+    with pytest.raises(MXNetError):
+        serving.ModelServer(buckets=[1], precision="int4")
+
+    # env-knob resolution: ctor wins over env; env wins over default
+    monkeypatch.setenv("MXTPU_SERVE_PRECISION", "int8")
+    assert serving.ModelServer(buckets=[1]).precision == "int8"
+    assert serving.ModelServer(
+        buckets=[1], precision="auto").precision == "auto"
+
+
+def test_precision_auto_accepts_both():
+    demo = _ranker()
+    qsym, qargs, qaux, _ = _calibrate(demo)
+    srv = serving.ModelServer(buckets=[1, 4], max_wait_us=100)
+    srv.add_model("f", demo["sym"], demo["args"], demo["aux"],
+                  input_shapes={"ids": (8,)})
+    srv.add_model("q", qsym, qargs, qaux, input_shapes={"ids": (8,)})
+    with srv:
+        ids = np.zeros((4, 8), np.int32)
+        f = srv.predict(ids=ids, model="f")[0]
+        g = srv.predict(ids=ids, model="q")[0]
+        st = srv.stats()
+    assert st["policy"]["precision"] == "auto"
+    assert st["per_model"]["q"]["quant"] != "none"
+    assert st["per_model"]["f"]["quant"] == "none"
+    assert np.argmax(f) == np.argmax(g)
+
+
+def test_program_key_carries_quant_tag():
+    """f32 and quantized forwards of the same architecture must live in
+    DIFFERENT program-cache tiers (no silent cross-tier reuse)."""
+    demo = _ranker()
+    qsym, _, _, _ = _calibrate(demo)
+    assert q.quant_tag(demo["sym"]) == "none"
+    tag = json.loads(q.quant_tag(qsym))
+    assert tag["dtype"] == "int8" and tag["weights"] >= 1
+
+
+# ----------------------------------------------------------------------
+# tune-plan licensing
+def test_tuneplan_precision_knob_and_gate_licensing(tmp_path):
+    from mxnet_tpu import tuneplan
+    from tools.autotune import read_quant_gate
+    key = {"jax": "x", "mesh": {"axes": {}, "devices": 1},
+           "platform": "cpu", "symbol": "abc"}
+    plan = {"version": 1, "key": key, "serve": {"precision": "int8"}}
+    tuneplan.validate(plan)
+    bad = {"version": 1, "key": key, "serve": {"precision": 8}}
+    with pytest.raises(MXNetError):
+        tuneplan.validate(bad)
+
+    gate_path = str(tmp_path / "QUANT_GATE.json")
+    gate = {"passed": True, "float_symbol_digest": "abc",
+            "calibration_digest": "d1", "argmax_agreement": 1.0,
+            "top1_delta_pt": 0.0}
+    json.dump(gate, open(gate_path, "w"))
+    assert read_quant_gate(gate_path, "abc")["passed"] is True
+    assert read_quant_gate(gate_path, "OTHER") is None     # wrong model
+    gate["passed"] = False
+    json.dump(gate, open(gate_path, "w"))
+    assert read_quant_gate(gate_path, "abc") is None       # failed gate
+    assert read_quant_gate(str(tmp_path / "missing.json"), "abc") is None
+
+
+# ----------------------------------------------------------------------
+# dequant-unfused jaxpr pass
+def _dq_findings(fn, *arg_arrays):
+    import jax
+    from mxnet_tpu.analysis.core import PassContext
+    from mxnet_tpu.analysis.jaxpr_passes import DequantUnfusedPass
+    jx = jax.make_jaxpr(fn)(*arg_arrays)
+    return DequantUnfusedPass().run(PassContext(jaxpr=jx))
+
+
+def test_dequant_unfused_pass():
+    import jax
+    import jax.numpy as jnp
+    W = np.ones((1024, 512), np.int8)        # 2 MB dequantized as f32
+    s = np.float32(0.01)
+    x = np.ones((4, 512), np.float32)
+
+    def fused(x):
+        return x @ (jnp.asarray(W).astype(jnp.float32) * s).T
+
+    def escapes(x):
+        wf = jnp.asarray(W).astype(jnp.float32) * s
+        return x @ wf.T, wf
+
+    def feeds_call(x):
+        wf = jnp.asarray(W).astype(jnp.float32) * s
+        def body(c, _):
+            return c + (x @ wf.T).sum(), None
+        return jax.lax.scan(body, 0.0, jnp.arange(3))[0]
+
+    def multi_dot(x):
+        wf = jnp.asarray(W).astype(jnp.float32) * s
+        return x @ wf.T + (x + 1) @ wf.T
+
+    def small(x):                            # under the 1 MiB floor
+        w = jnp.asarray(np.ones((64, 512), np.int8))
+        return x @ (w.astype(jnp.float32) * s).T
+
+    assert _dq_findings(fused, x) == []
+    assert _dq_findings(multi_dot, x) == []
+    assert _dq_findings(small, x) == []
+    esc = _dq_findings(escapes, x)
+    assert len(esc) == 1 and esc[0].severity == "error"
+    assert "output" in esc[0].detail["reason"]
+    call = _dq_findings(feeds_call, x)
+    assert len(call) == 1 and "scan" in call[0].detail["reason"]
+
+
+def test_quantized_graph_clean_under_dequant_pass():
+    """The rewriter's own dequant subgraphs must fuse: the quantized
+    serving forward yields ZERO dequant-unfused findings (this is the
+    graph_lint 'quantized-mlp' baseline as a unit test)."""
+    from mxnet_tpu import analysis
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=512, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=128, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    args = {"fc1_weight": mx.nd.array(rng.randn(512, 1024).astype("f")),
+            "fc1_bias": mx.nd.zeros((512,)),
+            "fc2_weight": mx.nd.array(rng.randn(128, 512).astype("f")),
+            "fc2_bias": mx.nd.zeros((128,))}
+    qsym, _, _ = q.quantize_model(sym, args, {})
+    report = analysis.lint_symbol(
+        qsym, shapes={"data": (8, 1024), "softmax_label": (8,)},
+        is_train=False, model="quantized-mlp")
+    assert [f for f in report.findings
+            if f.rule == "dequant-unfused"] == []
+
+
+def test_embedding_dequant_is_post_gather():
+    """The quantized Embedding must gather int8 rows THEN dequantize:
+    no float tensor of the full table shape may appear anywhere in the
+    traced serving program (the whole point of per-row scales)."""
+    import jax
+    from mxnet_tpu.analysis.jaxpr_passes import iter_eqns
+    from mxnet_tpu.executor import _GraphProgram
+    demo = _ranker(vocab=4000, dim=32)
+    qsym, qargs, qaux, _ = _calibrate(demo,
+                                      quantize_op_names=("Embedding",))
+    prog = _GraphProgram(qsym)
+    args = []
+    for n in prog.arg_names:
+        if n == "ids":
+            args.append(jax.ShapeDtypeStruct((4, 8), np.int32))
+        elif n == "softmax_label":
+            args.append(jax.ShapeDtypeStruct((4,), np.float32))
+        else:
+            v = qargs[n]
+            args.append(jax.ShapeDtypeStruct(tuple(v.shape),
+                                             v.asnumpy().dtype))
+    closed = jax.make_jaxpr(
+        lambda a: prog._eval(list(a), [], jax.random.key(0), False))(
+            tuple(args))
+    table_shape = tuple(qargs["embed_weight_quant"].shape)
+    for eqn in iter_eqns(closed):
+        for out in eqn.outvars:
+            aval = getattr(out, "aval", None)
+            if aval is None or tuple(aval.shape) != table_shape:
+                continue
+            assert aval.dtype == np.int8, (
+                "full-table %s tensor materialized by %s"
+                % (aval.dtype, eqn.primitive.name))
+
+
+# ----------------------------------------------------------------------
+# resilience extra_manifest plumbing
+def test_checkpoint_extra_manifest_core_keys_win(tmp_path):
+    """extra_manifest merges in but can never mask core manifest keys."""
+    from mxnet_tpu.resilience import CheckpointManager
+
+    class _Shim:
+        optimizer_initialized = False
+        def __init__(self, symbol, args):
+            self.symbol = symbol
+            self._args = args
+        def get_params(self):
+            return self._args, {}
+
+    data = mx.sym.Variable("data")
+    sym = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    args = {"fc_weight": mx.nd.zeros((4, 4)),
+            "fc_bias": mx.nd.zeros((4,))}
+    mgr = CheckpointManager(str(tmp_path / "m"))
+    ck = mgr.save(_Shim(sym, args), 1,
+                  extra_manifest={"quantization": {"x": 1},
+                                  "epoch": 999})
+    assert ck.manifest["quantization"] == {"x": 1}
+    assert ck.manifest["epoch"] == 1          # core key not masked

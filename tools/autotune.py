@@ -392,9 +392,26 @@ def timed_train_trial(sym, cfg, batch=64, steps=40, corpus=None,
 
 
 # ----------------------------------------------------------------------
+def read_quant_gate(path, symbol_digest):
+    """Load a tools/quantize.py gate artifact and decide whether the
+    plan may carry ``precision: int8``: the gate must have PASSED and
+    must have been measured on THIS plan's float symbol — a gate from
+    another model must never license a different tenant's tier.
+    Returns the gate record or None."""
+    if not path or not os.path.exists(path):
+        return None
+    with open(path) as f:
+        gate = json.load(f)
+    if not gate.get("passed"):
+        return None
+    if gate.get("float_symbol_digest") != symbol_digest:
+        return None
+    return gate
+
+
 def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
              corpus=None, requests=None, deadline_ms=250,
-             assert_no_worse=False, ratchet=None):
+             assert_no_worse=False, ratchet=None, quant_gate=None):
     """The search driver.  Returns (plan, summary); writes the plan to
     ``out`` and one corpus row per timed window."""
     import jax
@@ -507,6 +524,16 @@ def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
         winner = viable[0] if viable else None
         serve_cfg = winner["config"] if winner else dict(SERVE_DEFAULTS)
 
+        # --- gated precision knob: only a PASSED accuracy gate for
+        # THIS symbol licenses an int8 serve tier in the plan
+        # (tools/quantize.py writes the artifact; ModelServer enforces
+        # the tier at add_model)
+        gate = read_quant_gate(
+            quant_gate or os.environ.get("MXTPU_QUANT_GATE"), digest)
+        if gate is not None:
+            serve_cfg = dict(serve_cfg)
+            serve_cfg["precision"] = "int8"
+
         # --- the acceptance re-run: the winning timed trial repeated
         # against the now-warm program cache must compile ZERO programs
         recheck = trial(serve_cfg, "serve:warm-recheck")
@@ -555,6 +582,12 @@ def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
             },
             "meta": {"tool": "tools/autotune.py", "network": network,
                      "micro": bool(micro), "seed": seed,
+                     "quant_gate": None if gate is None else {
+                         "calibration_digest":
+                             gate.get("calibration_digest"),
+                         "argmax_agreement":
+                             gate.get("argmax_agreement"),
+                         "top1_delta_pt": gate.get("top1_delta_pt")},
                      "requests_per_window": n_req,
                      "rows_mix": list(rows_mix),
                      "surrogate_candidates": len(candidates),
@@ -770,6 +803,11 @@ def main(argv=None):
     ap.add_argument("--verify", default=None, metavar="PLAN",
                     help="load PLAN through Trainer+ModelServer and "
                          "assert it applied, then exit")
+    ap.add_argument("--quant-gate", default=None, metavar="GATE_JSON",
+                    help="tools/quantize.py gate artifact; a PASSED "
+                         "gate matching the tuned symbol lets the plan "
+                         "carry serve precision=int8 (default: "
+                         "MXTPU_QUANT_GATE)")
     args = ap.parse_args(argv)
 
     if args.verify:
@@ -779,7 +817,8 @@ def main(argv=None):
         network=args.network, micro=args.micro, top_k=args.top_k,
         seed=args.seed, out=args.out, corpus=args.corpus,
         requests=args.requests, deadline_ms=args.deadline_ms,
-        assert_no_worse=args.assert_no_worse, ratchet=args.ratchet)
+        assert_no_worse=args.assert_no_worse, ratchet=args.ratchet,
+        quant_gate=args.quant_gate)
     print(json.dumps(summary, indent=1))
     return 0
 

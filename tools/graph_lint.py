@@ -112,6 +112,40 @@ def serving_report():
     return report
 
 
+def quantized_mlp_report():
+    """Lint a QUANTIZED serving graph: an MLP with a >1 MB weight put
+    through ``contrib.quantization.quantize_model`` (weights-only) and
+    traced as the eval program.  The dequant-unfused jaxpr pass walks
+    the int8->f32 ``convert_element_type`` chains; the checked-in
+    baseline records ZERO findings — a finding here means the dequant
+    subgraph the rewriter emits stopped fusing into its consumer, i.e.
+    the int8 footprint/bandwidth win silently regressed
+    (docs/how_to/quantization.md).  Pure trace time, like the bench
+    targets."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import analysis
+    from mxnet_tpu.contrib import quantization
+
+    data = mx.sym.Variable("data")
+    net = mx.symbol.FullyConnected(data, num_hidden=512, name="fc1")
+    net = mx.symbol.Activation(net, act_type="relu")
+    net = mx.symbol.FullyConnected(net, num_hidden=128, name="fc2")
+    sym = mx.symbol.SoftmaxOutput(net, name="softmax")
+    rng = np.random.RandomState(0)
+    # fc1: (512, 1024) -> 512K int8 elems, a 2 MB f32 dequant (over the
+    # pass's 1 MiB floor); fc2 stays above min_elems too so BOTH
+    # dequant chains are exercised
+    args = {"fc1_weight": mx.nd.array(rng.randn(512, 1024).astype("f")),
+            "fc1_bias": mx.nd.array(np.zeros(512, "f")),
+            "fc2_weight": mx.nd.array(rng.randn(128, 512).astype("f")),
+            "fc2_bias": mx.nd.array(np.zeros(128, "f"))}
+    qsym, _, _ = quantization.quantize_model(sym, args, {})
+    report = analysis.lint_symbol(
+        qsym, shapes={"data": (8, 1024), "softmax_label": (8,)},
+        is_train=False, model="quantized-mlp")
+    return report
+
+
 def _parse_shapes(specs):
     """--shape name=(1,224,224,3) pairs -> dict."""
     import ast
@@ -188,6 +222,7 @@ def main(argv=None):
     else:
         targets = bench_targets()
         names = args.model or sorted(targets) + ["trainer-step", "serving",
+                                                 "quantized-mlp",
                                                  "program-source"]
         for name in names:
             if name == "trainer-step":
@@ -195,6 +230,9 @@ def main(argv=None):
                 continue
             if name == "serving":
                 reports[name] = serving_report()
+                continue
+            if name == "quantized-mlp":
+                reports[name] = quantized_mlp_report()
                 continue
             if name == "program-source":
                 # the program-bypass AST rule over the unified-path
@@ -206,7 +244,8 @@ def main(argv=None):
                 continue
             if name not in targets:
                 raise SystemExit("unknown bench model %r (have %s, "
-                                 "trainer-step, serving, program-source)"
+                                 "trainer-step, serving, quantized-mlp, "
+                                 "program-source)"
                                  % (name, sorted(targets)))
             t = targets[name]
             reports[name] = analysis.lint_symbol(

@@ -1,0 +1,495 @@
+#!/usr/bin/env python
+"""Calibrated int8 quantization CLI: calibrate -> gate -> emit -> serve.
+
+The deploy path for ``mx.contrib.quantization.calibrate_model``
+(docs/how_to/quantization.md):
+
+1. **calibrate** — run the float forward over a calibration set,
+   capture per-activation ranges (minmax or percentile), emit the
+   statically-quantized symbol + params and the Finding-style emission
+   report (what quantized, what stayed float and why).
+2. **gate** — score float vs quantized on a HELD-OUT set: argmax
+   agreement and top-1 accuracy delta.  Emission is REFUSED when the
+   gate fails (``--check`` runs the gate without writing anything;
+   exit 3 on failure either way).
+3. **emit** — write the quantized checkpoint through
+   ``CheckpointManager`` so the manifest stamps the quantization
+   config + calibration digest next to the integrity fingerprint
+   (``latest_verified()`` round-trips it like any trained checkpoint),
+   plus a ``QUANT_GATE.json`` artifact ``tools/autotune.py
+   --quant-gate`` reads before it may put ``precision: int8`` in a
+   tune plan.
+4. **--serve** — reload the emitted checkpoint through
+   ``latest_verified()`` and drive it through a Predictor AND an int8
+   ModelServer tenant (the CI calibrate->gate->serve stage).
+
+Self-contained demo models (``--demo convnet|ranker``) train/plant a
+small net in-process; ``--load PREFIX --load-epoch N --calib F.npz``
+quantizes an existing float checkpoint (npz arrays keyed by input
+name, ``label`` optional; ``--holdout`` defaults to the calib file).
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# demo models
+def demo_convnet(seed=0):
+    """A trained 4-class convnet: conv stays float via min_elems,
+    fc1/fc2 + the flatten activation quantize.  Classes are encoded in
+    activation MAGNITUDE (class k = base pattern scaled by m_k), so a
+    range-clipped calibration — which saturates every magnitude to the
+    same ceiling — collapses the classes and the gate refuses."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    base = np.abs(rng.normal(0, 1, (1, 8, 8)))
+    mags = np.array([0.6, 1.1, 1.6, 2.1])
+    y = rng.randint(0, 4, 768)
+    x = (mags[y][:, None, None, None] * base
+         + 0.05 * rng.normal(0, 1, (768, 1, 8, 8))).astype("f")
+    data = mx.sym.Variable("data")
+    net = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8,
+                             pad=(1, 1), name="conv1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    it = mx.io.NDArrayIter(x[:512], y[:512].astype("f"), 64,
+                           shuffle=True)
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(it, num_epoch=6, optimizer="adam",
+            optimizer_params={"learning_rate": 0.01},
+            initializer=mx.init.Xavier())
+    arg_p, aux_p = mod.get_params()
+    return {"sym": net, "args": arg_p, "aux": aux_p,
+            "data_names": ("data",),
+            "calib": {"data": x[:256]},
+            "holdout": {"data": x[512:]}, "labels": y[512:],
+            "example_shapes": {"data": (1, 8, 8)},
+            "min_elems": 100, "batch": 64}
+
+
+def demo_ranker(seed=0, vocab=8000, dim=64, slots=8, classes=16,
+                n_holdout=512, hidden=128):
+    """An embedding-heavy ranker with an analytically planted readout
+    (each table row carries its class prototype; fc1's first rows read
+    slot 0 against the prototypes) — a stand-in for a trained ranker
+    with real logit margins, exercising the table path where int8
+    serving wins: per-row scales, dequantized AFTER the gather."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    P = rng.normal(0, 1, (classes, dim))
+    P /= np.linalg.norm(P, axis=1, keepdims=True)
+    W = (1.5 * P[np.arange(vocab) % classes]
+         + 0.35 * rng.normal(0, 1, (vocab, dim))).astype("f")
+    width = slots * dim
+    fc1_w = (0.02 * rng.normal(0, 1, (hidden, width))).astype("f")
+    fc1_w[:classes, :dim] = P          # planted slot-0 readout
+    head_w = (0.05 * rng.normal(0, 1, (classes, hidden))).astype("f")
+    head_w[:, :classes] += 2.0 * np.eye(classes, dtype="f")
+
+    ids = mx.sym.Variable("ids")
+    net = mx.sym.Embedding(ids, input_dim=vocab, output_dim=dim,
+                           name="embed")
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=hidden, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="head")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"embed_weight": mx.nd.array(W),
+            "fc1_weight": mx.nd.array(fc1_w),
+            "fc1_bias": mx.nd.zeros((hidden,)),
+            "head_weight": mx.nd.array(head_w),
+            "head_bias": mx.nd.zeros((classes,))}
+    calib_ids = rng.randint(0, vocab, (256, slots)).astype(np.int32)
+    hold_ids = rng.randint(0, vocab, (n_holdout, slots)) \
+        .astype(np.int32)
+    return {"sym": net, "args": args, "aux": {},
+            "data_names": ("ids",),
+            "calib": {"ids": calib_ids},
+            "holdout": {"ids": hold_ids},
+            "labels": hold_ids[:, 0] % classes,
+            "example_shapes": {"ids": (slots,)},
+            "min_elems": 512, "batch": 64}
+
+
+def demo_pool_ranker(seed=0, vocab=20_000, dim=128, slots=64,
+                     classes=32, n_holdout=256, skew=0.4,
+                     n_calib=256):
+    """A bag-of-ids pooling ranker: embed -> mean over ``slots`` ->
+    prototype head.  Each bag is SKEWED — ``skew`` of its slots come
+    from the label's class rows, the rest uniform — so the pooled
+    vector leans toward the label prototype.  The gather IS the
+    workload (no wide dense layer), which is the regime where the
+    quantized table's 4x-fewer gathered bytes shows up as serving
+    latency, not just footprint (tools/serve_bench.py quant_probe runs
+    this at production-ish sizes).  Mean pooling also averages the
+    per-row quant noise down by ~sqrt(slots), so agreement is near
+    perfect — the favorable case the accuracy gate should wave
+    through."""
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(seed)
+    P = rng.normal(0, 1, (classes, dim))
+    P /= np.linalg.norm(P, axis=1, keepdims=True)
+    W = (1.5 * P[np.arange(vocab) % classes]
+         + 0.35 * rng.normal(0, 1, (vocab, dim))).astype("f")
+
+    def bags(n):
+        y = rng.randint(0, classes, n)
+        ids = rng.randint(0, vocab, (n, slots))
+        n_skew = max(1, int(skew * slots))
+        for i in range(n):
+            picks = rng.randint(0, vocab // classes, n_skew)
+            ids[i, :n_skew] = picks * classes + y[i]
+        return ids.astype(np.int32), y
+
+    ids_sym = mx.sym.Variable("ids")
+    net = mx.sym.Embedding(ids_sym, input_dim=vocab, output_dim=dim,
+                           name="embed")
+    net = mx.sym.mean(net, axis=1, name="pool")
+    net = mx.sym.FullyConnected(net, num_hidden=classes, name="head")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    args = {"embed_weight": mx.nd.array(W),
+            "head_weight": mx.nd.array(P.astype("f")),
+            "head_bias": mx.nd.zeros((classes,))}
+    calib_ids, _ = bags(n_calib)
+    hold_ids, hold_y = bags(n_holdout)
+    return {"sym": net, "args": args, "aux": {},
+            "data_names": ("ids",),
+            "calib": {"ids": calib_ids},
+            "holdout": {"ids": hold_ids}, "labels": hold_y,
+            "example_shapes": {"ids": (slots,)},
+            "min_elems": 512, "batch": 64}
+
+
+DEMOS = {"convnet": demo_convnet, "ranker": demo_ranker,
+         "pool-ranker": demo_pool_ranker}
+
+
+# ----------------------------------------------------------------------
+# scoring + gate
+def score(sym, args, aux, data, data_names, batch):
+    """Forward the full ``data`` dict through an eval-bound Module,
+    returning the first output (class probabilities)."""
+    import mxnet_tpu as mx
+    n = len(next(iter(data.values())))
+    label_names = [a for a in sym.list_arguments()
+                   if a not in args and a not in data_names
+                   and a.endswith("label")]
+    mod = mx.mod.Module(sym, data_names=tuple(data_names),
+                        label_names=label_names, context=mx.cpu())
+    label_shapes = [mx.io.DataDesc(l, (batch,)) for l in label_names]
+    mod.bind(data_shapes=[
+        mx.io.DataDesc(name, (batch,) + tuple(data[name].shape[1:]),
+                       dtype=data[name].dtype)
+        for name in data_names],
+        label_shapes=label_shapes or None, for_training=False)
+    mod.set_params(args, aux)
+    zero_labels = [mx.nd.zeros((batch,)) for _ in label_names]
+    outs = []
+    for s in range(0, n, batch):
+        e = min(s + batch, n)
+        pad = batch - (e - s)
+        chunk = []
+        for name in data_names:
+            a = data[name][s:e]
+            if pad:
+                a = np.concatenate([a, np.repeat(a[-1:], pad, 0)])
+            chunk.append(mx.nd.array(a, dtype=data[name].dtype))
+        mod.forward(mx.io.DataBatch(data=chunk, label=zero_labels),
+                    is_train=False)
+        outs.append(mod.get_outputs()[0].asnumpy()[:e - s])
+    return np.concatenate(outs)
+
+
+def evaluate_gate(ref_probs, q_probs, labels, min_agreement,
+                  max_top1_delta):
+    """The accuracy gate: argmax agreement vs the float model on the
+    holdout, plus top-1 accuracy delta when labels are known."""
+    ref_top = ref_probs.argmax(1)
+    q_top = q_probs.argmax(1)
+    agreement = float((ref_top == q_top).mean())
+    record = {"argmax_agreement": round(agreement, 6),
+              "holdout_examples": int(len(ref_top)),
+              "thresholds": {"min_agreement": float(min_agreement),
+                             "max_top1_delta_pt": float(max_top1_delta)}}
+    passed = agreement >= float(min_agreement)
+    if labels is not None:
+        labels = np.asarray(labels)
+        top1_f32 = float((ref_top == labels).mean())
+        top1_q = float((q_top == labels).mean())
+        delta_pt = (top1_f32 - top1_q) * 100.0
+        record.update({"top1_f32": round(top1_f32, 6),
+                       "top1_quant": round(top1_q, 6),
+                       "top1_delta_pt": round(delta_pt, 4)})
+        passed = passed and delta_pt <= float(max_top1_delta)
+    record["passed"] = bool(passed)
+    return record
+
+
+# ----------------------------------------------------------------------
+# emission
+class _QuantizedModule:
+    """The minimal module shape ``CheckpointManager.save`` needs, with
+    a host-side integrity fingerprint so the emitted checkpoint passes
+    ``latest_verified()`` exactly like a trained one."""
+
+    optimizer_initialized = False
+
+    def __init__(self, symbol, arg_params, aux_params):
+        self.symbol = symbol
+        self._args = arg_params
+        self._aux = aux_params
+
+    def get_params(self):
+        return self._args, self._aux
+
+    def state_fingerprint(self):
+        from mxnet_tpu import integrity
+
+        def host(d):
+            return {k: np.asarray(v.asnumpy() if hasattr(v, "asnumpy")
+                                  else v) for k, v in d.items()}
+        named = integrity.named_state_leaves(host(self._args),
+                                             host(self._aux))
+        g, leaves = integrity.host_fingerprint(named)
+        return integrity.manifest_record(g, leaves)
+
+
+def emit_checkpoint(prefix, epoch, qsym, qargs, qaux, gate, calib):
+    """Write the quantized checkpoint; the manifest carries the
+    quantization config + calibration digest + gate outcome."""
+    from mxnet_tpu.resilience import CheckpointManager
+    mgr = CheckpointManager(prefix)
+    ck = mgr.save(_QuantizedModule(qsym, qargs, qaux), epoch,
+                  extra_manifest={"quantization": {
+                      "config": calib.config,
+                      "calibration_digest": calib.digest,
+                      "gate": gate}})
+    return mgr, ck
+
+
+# ----------------------------------------------------------------------
+def run_serve_check(prefix, epoch, demo, gate):
+    """The serve leg: reload through latest_verified(), bind through
+    Predictor AND an int8-tier ModelServer tenant, check agreement with
+    the in-process quantized scores and true 1-byte table storage."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.predictor import Predictor
+    from mxnet_tpu.resilience import CheckpointManager
+    from mxnet_tpu import serving
+
+    ck = CheckpointManager(prefix).latest_verified()
+    if ck is None or ck.epoch != epoch:
+        raise SystemExit("emitted checkpoint did not verify "
+                         "(latest_verified=%s)" % (ck,))
+    qsym, qargs, qaux = ck.load_params()
+    name = next(iter(demo["data_names"]))
+    hold = demo["holdout"][name][:64]
+
+    pred = Predictor.from_checkpoint(prefix, epoch,
+                                     {name: tuple(hold.shape)})
+    pred.set_input(name, hold)
+    pred.forward()
+    p_out = pred.get_output(0)
+
+    srv = serving.ModelServer(buckets=[1, 32, 64], max_wait_us=200,
+                              precision="int8")
+    srv.add_model("quant", qsym, qargs, qaux,
+                  input_shapes=demo["example_shapes"])
+    with srv:
+        s_out = srv.predict(**{name: hold})[0]
+        stats = srv.stats()
+    pm = stats["per_model"]["quant"]
+    int8_bytes = sum(
+        int(np.prod(v.shape)) for k, v in qargs.items()
+        if k.endswith("_quant"))
+    agree = float((np.asarray(p_out).argmax(1)
+                   == np.asarray(s_out).argmax(1)).mean())
+    return {"predictor_vs_server_agreement": agree,
+            "weight_bytes_on_device": pm["weight_bytes_on_device"],
+            "int8_weight_bytes": int8_bytes,
+            "precision": stats["policy"]["precision"],
+            "quant_tag": pm["quant"]}
+
+
+def load_npz(path):
+    if not path:
+        return None
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_argument_group("model source")
+    src.add_argument("--demo", choices=sorted(DEMOS), default=None,
+                     help="self-contained demo model (CI smoke)")
+    src.add_argument("--load", default=None, metavar="PREFIX",
+                     help="float checkpoint prefix to quantize")
+    src.add_argument("--load-epoch", type=int, default=1)
+    src.add_argument("--calib", default=None, metavar="NPZ",
+                     help="calibration arrays keyed by input name "
+                          "(with --load)")
+    src.add_argument("--holdout", default=None, metavar="NPZ",
+                     help="held-out arrays (+ optional 'label'); "
+                          "default: the calibration file")
+    ap.add_argument("--calib-mode", default=None,
+                    choices=("minmax", "percentile"),
+                    help="default MXTPU_QUANT_MODE (minmax)")
+    ap.add_argument("--percentile", type=float, default=None,
+                    help="default MXTPU_QUANT_PERCENTILE (99.9)")
+    ap.add_argument("--calib-batches", type=int, default=None)
+    ap.add_argument("--min-elems", type=int, default=None)
+    ap.add_argument("--clip-calib", type=float, default=1.0,
+                    help="scale calibration data by this factor (a "
+                         "deliberately range-clipped calibration; the "
+                         "gate must refuse it — used by tests/CI)")
+    ap.add_argument("--min-agreement", type=float, default=None,
+                    help="default MXTPU_QUANT_MIN_AGREEMENT (0.99)")
+    ap.add_argument("--max-top1-delta", type=float, default=None,
+                    help="points; default MXTPU_QUANT_MAX_TOP1_DELTA "
+                         "(0.5)")
+    ap.add_argument("--out-dir", default=None,
+                    help="checkpoint output dir (default: alongside "
+                         "--load, or a temp dir for --demo)")
+    ap.add_argument("--prefix", default="quantized",
+                    help="emitted checkpoint prefix name")
+    ap.add_argument("--epoch", type=int, default=1)
+    ap.add_argument("--gate-out", default=None,
+                    help="gate artifact path (default: "
+                         "OUT_DIR/QUANT_GATE.json)")
+    ap.add_argument("--check", action="store_true",
+                    help="gate only — write nothing, exit 3 on failure")
+    ap.add_argument("--serve", action="store_true",
+                    help="after emission, reload via latest_verified() "
+                         "and serve through Predictor + int8 "
+                         "ModelServer")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import envknobs
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.program import symbol_digest
+
+    min_agreement = args.min_agreement if args.min_agreement is not None \
+        else envknobs.get_float("MXTPU_QUANT_MIN_AGREEMENT", 0.99)
+    max_top1_delta = args.max_top1_delta \
+        if args.max_top1_delta is not None \
+        else envknobs.get_float("MXTPU_QUANT_MAX_TOP1_DELTA", 0.5)
+
+    if args.demo:
+        demo = DEMOS[args.demo](seed=args.seed)
+    elif args.load:
+        sym, arg_p, aux_p = mx.model.load_checkpoint(args.load,
+                                                     args.load_epoch)
+        calib = load_npz(args.calib)
+        if not calib:
+            raise SystemExit("--load requires --calib NPZ")
+        hold = load_npz(args.holdout) or dict(calib)
+        labels = hold.pop("label", None)
+        calib.pop("label", None)
+        demo = {"sym": sym, "args": arg_p, "aux": aux_p,
+                "data_names": tuple(sorted(calib)),
+                "calib": calib, "holdout": hold, "labels": labels,
+                "example_shapes": {k: tuple(v.shape[1:])
+                                   for k, v in hold.items()},
+                "min_elems": 1024, "batch": 64}
+    else:
+        raise SystemExit("one of --demo / --load is required")
+
+    min_elems = args.min_elems if args.min_elems is not None \
+        else demo["min_elems"]
+    calib_data = dict(demo["calib"])
+    if args.clip_calib != 1.0:
+        # a deliberately wrong calibration: float inputs scaled down
+        # (ranges too small -> serving data clips), integer id inputs
+        # pinned to row 0 (ranges observed on one row only)
+        for k, v in calib_data.items():
+            if np.issubdtype(v.dtype, np.floating):
+                calib_data[k] = (v * args.clip_calib).astype(v.dtype)
+            else:
+                calib_data[k] = np.zeros_like(v)
+
+    it = mx.io.NDArrayIter(calib_data, None, demo["batch"])
+    qsym, qargs, qaux, calib = q.calibrate_model(
+        demo["sym"], demo["args"], demo["aux"], calib_iter=it,
+        num_calib_batches=args.calib_batches,
+        calib_mode=args.calib_mode, percentile=args.percentile,
+        min_elems=min_elems)
+
+    ref = score(demo["sym"], demo["args"], demo["aux"],
+                demo["holdout"], demo["data_names"], demo["batch"])
+    got = score(qsym, qargs, qaux, demo["holdout"],
+                demo["data_names"], demo["batch"])
+    gate = evaluate_gate(ref, got, demo.get("labels"), min_agreement,
+                         max_top1_delta)
+    gate.update({
+        "tool": "tools/quantize.py",
+        "network": args.demo or args.load,
+        "float_symbol_digest": symbol_digest(demo["sym"]),
+        "quant_symbol_digest": symbol_digest(qsym),
+        "calibration_digest": calib.digest,
+        "config": calib.config,
+    })
+
+    out = {"gate": gate,
+           "report": [f.to_dict() for f in calib.report.findings]}
+
+    if args.check:
+        print(json.dumps(out if args.json else gate, indent=1,
+                         sort_keys=True))
+        return 0 if gate["passed"] else 3
+
+    out_dir = args.out_dir
+    if out_dir is None:
+        out_dir = os.path.dirname(os.path.abspath(args.load)) \
+            if args.load else tempfile.mkdtemp(prefix="mxtpu-quant-")
+    os.makedirs(out_dir, exist_ok=True)
+    gate_path = args.gate_out or os.path.join(out_dir,
+                                              "QUANT_GATE.json")
+    with open(gate_path, "w") as f:
+        json.dump(gate, f, indent=1, sort_keys=True)
+    out["gate_path"] = gate_path
+
+    if not gate["passed"]:
+        # the whole point: no quantized checkpoint past a failed gate
+        print(json.dumps(out if args.json else gate, indent=1,
+                         sort_keys=True))
+        print("gate FAILED — emission refused (agreement %.4f < %.4f "
+              "or top-1 delta over %.2fpt); no checkpoint written"
+              % (gate["argmax_agreement"], min_agreement,
+                 max_top1_delta), file=sys.stderr)
+        return 3
+
+    prefix = os.path.join(out_dir, args.prefix)
+    _, ck = emit_checkpoint(prefix, args.epoch, qsym, qargs, qaux,
+                            gate, calib)
+    out["checkpoint"] = {"prefix": prefix, "epoch": ck.epoch,
+                         "manifest_quantization":
+                             ck.manifest.get("quantization", {})
+                             .get("calibration_digest")}
+
+    if args.serve:
+        out["serve"] = run_serve_check(prefix, args.epoch, demo, gate)
+
+    print(json.dumps(out if args.json else
+                     {k: v for k, v in out.items() if k != "report"},
+                     indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, ROOT)
+    sys.exit(main())

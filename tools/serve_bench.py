@@ -188,7 +188,8 @@ def arrival_schedule(n, rate_rps, seed):
 
 
 def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
-                      shed_exceptions=(), arrivals=None):
+                      shed_exceptions=(), arrivals=None,
+                      input_name="data"):
     """The shared open-loop arrival engine: a Poisson schedule fixed up
     front (``arrivals`` — or drawn here from ``seed``) and honored
     regardless of how far behind the server falls.  Submits shed with
@@ -206,8 +207,8 @@ def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
         while i < len(payloads) and arrivals[i] <= now:
             ts = time.perf_counter()
             try:
-                futures.append(server.submit(data=payloads[i],
-                                             model=model))
+                futures.append(server.submit(
+                    {input_name: payloads[i]}, model=model))
             except shed_exceptions:
                 rejected += 1
                 reject_max_ms = max(
@@ -221,13 +222,14 @@ def _open_loop_submit(server, payloads, rate_rps, model=None, seed=2,
 
 
 def poisson_run(server, payloads, rate_rps, model=None, seed=2,
-                arrivals=None):
+                arrivals=None, input_name="data"):
     """Open-loop Poisson arrivals at ``rate_rps`` requests/s (a shed —
     possible since queues are bounded by default — propagates: this
     sweep stays at loads the server keeps up with)."""
     futures, _, _, _, t0 = _open_loop_submit(server, payloads, rate_rps,
                                              model=model, seed=seed,
-                                             arrivals=arrivals)
+                                             arrivals=arrivals,
+                                             input_name=input_name)
     ok, failed, lat = 0, 0, []
     for f in futures:
         try:
@@ -509,6 +511,139 @@ def serving_probe(network="mlp", quick=True, buckets=None,
 
 
 # ----------------------------------------------------------------------
+def quant_probe(quick=True, seed=0, vocab=400_000, dim=512, slots=256,
+                classes=32, rows=32, requests=None):
+    """Quantized serving vs f32, same arrivals: the INFER_BENCH
+    ``quant`` section.
+
+    The workload is the case int8 serving exists for — a bag-of-ids
+    pooling ranker whose per-request cost is gathering ``rows x slots``
+    random rows out of a table far bigger than any cache
+    (``vocab x dim`` f32 = hundreds of MB).  The table is quantized
+    through the full deploy path (``calibrate_model`` -> accuracy gate
+    -> int8-tier tenant), so the section carries the gate verdict next
+    to the latency numbers: a speed win that failed its accuracy gate
+    is not reportable.  Only the table is quantized
+    (``quantize_op_names=("Embedding",)``) — dense-layer dequant GEMMs
+    are a per-platform call the autotuner owns, while the
+    gather-then-dequant pattern (1 byte/row-element moved instead of 4,
+    dequantized AFTER the gather against per-row scales) wins on
+    bandwidth on every tier.
+
+    Both tenants serve IDENTICAL seeded Poisson arrivals at
+    ``rows``-row payloads (>= 32 per the acceptance bar — at batch 1
+    the dequant overhead wins instead, see ``benchmark_score.py``
+    ``vs_f32``), and the probe re-binds the quantized model under the
+    warm program cache to assert ZERO compiles (the quantized tier is a
+    first-class program-cache citizen, not a retrace source)."""
+    from mxnet_tpu import program, serving
+    from mxnet_tpu.contrib import quantization
+    import mxnet_tpu as mx
+    from tools.quantize import demo_pool_ranker, evaluate_gate, score
+
+    demo = demo_pool_ranker(seed=seed, vocab=vocab, dim=dim,
+                            slots=slots, classes=classes,
+                            n_holdout=256)
+    it = mx.io.NDArrayIter({"ids": demo["calib"]["ids"]}, None, 64)
+    qsym, qargs, qaux, calib = quantization.calibrate_model(
+        demo["sym"], demo["args"], demo["aux"], calib_iter=it,
+        quantize_op_names=("Embedding",))
+
+    ref = score(demo["sym"], demo["args"], demo["aux"],
+                demo["holdout"], demo["data_names"], 64)
+    got = score(qsym, qargs, qaux, demo["holdout"],
+                demo["data_names"], 64)
+    from mxnet_tpu import envknobs
+    gate = evaluate_gate(
+        ref, got, demo["labels"],
+        envknobs.get_float("MXTPU_QUANT_MIN_AGREEMENT", 0.99),
+        envknobs.get_float("MXTPU_QUANT_MAX_TOP1_DELTA", 0.5))
+    gate["calibration_digest"] = calib.digest
+
+    n_req = requests or (80 if quick else 400)
+    rng = np.random.RandomState(seed + 7)
+    payloads = [rng.randint(0, vocab, (rows, slots)).astype(np.int32)
+                for _ in range(n_req)]
+
+    def make_server(precision, sym, args, aux):
+        srv = serving.ModelServer(buckets=[rows], max_wait_us=200,
+                                  precision=precision)
+        srv.add_model("ranker", sym, args, aux,
+                      input_shapes={"ids": (slots,)})
+        return srv
+
+    # capacity estimate on the f32 tenant -> one arrival schedule BOTH
+    # tenants replay (identical offered load, identical sequence)
+    with make_server("float32", demo["sym"], demo["args"],
+                     demo["aux"]) as srv:
+        srv.predict(ids=payloads[0])                       # warm
+        t0 = time.perf_counter()
+        for p in payloads[:10]:
+            srv.predict(ids=p)
+        per_req = (time.perf_counter() - t0) / 10
+    rate = 0.6 / per_req
+    arrivals = arrival_schedule(n_req, rate, seed + 11)
+
+    runs = {}
+    for precision, (s, a, x) in (
+            ("float32", (demo["sym"], demo["args"], demo["aux"])),
+            ("int8", (qsym, qargs, qaux))):
+        with make_server(precision, s, a, x) as srv:
+            runs[precision] = poisson_run(srv, payloads, rate,
+                                          arrivals=arrivals,
+                                          input_name="ids")
+            srv.assert_no_retrace()
+            st = srv.stats()
+            runs[precision]["weight_bytes_on_device"] = \
+                st["per_model"]["ranker"]["weight_bytes_on_device"]
+
+    f32, q = runs["float32"], runs["int8"]
+    vs = {"p50": round(f32["p50_ms"] / q["p50_ms"], 3),
+          "p99": round(f32["p99_ms"] / q["p99_ms"], 3),
+          "goodput_rows_per_sec": round(
+              q["achieved_rows_per_sec"]
+              / f32["achieved_rows_per_sec"], 3),
+          "weight_bytes": round(
+              f32["weight_bytes_on_device"]
+              / q["weight_bytes_on_device"], 2)}
+
+    # warm-cache re-bind: constructing the SAME quantized tenant again
+    # must compile nothing — loads/hits only (program keys carry the
+    # quant tag, so the int8 tier has its own stable entries)
+    cache_was = os.environ.get("MXTPU_PROGRAM_CACHE")
+    if not cache_was:
+        import tempfile
+        os.environ["MXTPU_PROGRAM_CACHE"] = tempfile.mkdtemp(
+            prefix="mxtpu-quant-bench-")
+    try:
+        with make_server("int8", qsym, qargs, qaux) as srv:
+            srv.predict(ids=payloads[0])                   # seed cache
+        with program.stats_delta() as warm:
+            with make_server("int8", qsym, qargs, qaux) as srv:
+                srv.predict(ids=payloads[0])
+    finally:
+        if not cache_was:
+            os.environ.pop("MXTPU_PROGRAM_CACHE", None)
+
+    return {
+        "model": {"network": "pool-ranker", "vocab": vocab, "dim": dim,
+                  "slots": slots, "classes": classes,
+                  "quantized": "embedding table (per-row scales, "
+                               "dequant after gather)",
+                  "config": calib.config},
+        "gate": gate,
+        "request_rows": rows,
+        "offered_rps": round(rate, 1),
+        "f32": f32,
+        "int8": q,
+        "vs_f32": vs,
+        "warm_cache": {"compiles": warm["compiles"],
+                       "loads": warm["loads"],
+                       "cache_hit": warm["cache_hit"]},
+        "retraces": 0,
+    }
+
+
 def obs_overhead_probe(network="mlp-wide", pairs=3, n=200, buckets=None,
                        seed=0):
     """Measure the cost of ``MXTPU_OBS=1`` span recording + JSONL
@@ -608,6 +743,9 @@ def main(argv=None):
                          "this INFER_BENCH.json artifact")
     ap.add_argument("--no-overload", action="store_true",
                     help="skip the goodput-under-overload sweep")
+    ap.add_argument("--quant", action="store_true",
+                    help="also run the quantized-vs-f32 ranker sweep "
+                         "(the INFER_BENCH 'quant' section)")
     args = ap.parse_args(argv)
 
     buckets = [int(b) for b in args.buckets.split(",")] \
@@ -633,6 +771,11 @@ def main(argv=None):
                      overload["goodput_max_load_rps"],
                      overload["base_load_factor"],
                      overload["goodput_base_rps"]), file=sys.stderr)
+    quant = None
+    if args.quant:
+        quant = quant_probe(quick=args.quick)
+        quant["device"] = device
+        print(json.dumps(quant, indent=1))
     if args.out:
         artifact = {}
         if os.path.exists(args.out):
@@ -641,6 +784,8 @@ def main(argv=None):
         artifact["serving"] = section
         if overload is not None:
             artifact["overload"] = overload
+        if quant is not None:
+            artifact["quant"] = quant
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=1)
             f.write("\n")
