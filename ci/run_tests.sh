@@ -205,6 +205,18 @@ stage "comm lint gate (static collective-communication analysis)"
 # time, docs/how_to/static_analysis.md "Communication analysis"
 python tools/comm_lint.py --check
 
+stage "mem lint gate (static buffer-liveness peak-HBM analysis)"
+# walks the SAME lowered programs as the comm gate and predicts the
+# per-chip peak from a buffer-liveness timeline (donated state freed
+# at its donation point, ZeRO-sharded optimizer state priced through
+# its committed sharding, checkpointed regions at their transient
+# working-set floor), then runs the mem rules (mem-budget,
+# mem-capacity, remat-opportunity, donation-missed, pad-waste) and
+# FAILS on NEW error findings or a predicted-GB regression vs the
+# checked-in MEM_BASELINE.json (ratchet with --write-baseline) — pure
+# trace time, docs/how_to/static_analysis.md "Memory analysis"
+python tools/mem_lint.py --check
+
 stage "runtime telemetry suite (metrics registry / spans / trace export)"
 # the unified-observability layer: registry snapshot/merge, serving
 # request + training step span trees, correlation-ID propagation
@@ -220,8 +232,9 @@ stage "concurrency sanitizer gate (static lint + MXTPU_TSAN=1 lockset sweep)"
 # half 1: the AST thread-safety rules over mxnet_tpu/ (no imports, no
 # devices) gated on RACE_BASELINE.json — unnamed threads, undeclared
 # daemon policy, unlocked thread-target mutation, blocking calls under
-# a lock.  half 2: re-run the serving + stream-pipeline + elastic unit
-# suites with the runtime lockset/lock-order recorder ON — and the
+# a lock.  half 2: re-run the serving + stream-pipeline + elastic +
+# mem-admission unit suites with the runtime lockset/lock-order
+# recorder ON — and the
 # span recorder armed too (MXTPU_OBS=1): the obs layer's locks and the
 # registry mutex nest inside the subsystem locks they serve, and the
 # sweep proves the discipline holds under load (new locks must keep
@@ -238,7 +251,8 @@ timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 MXTPU_OBS=1 \
     python -m pytest tests/test_serving.py tests/test_serving_overload.py \
         tests/test_stream_pipeline.py tests/test_obs.py \
         tests/test_elastic.py tests/test_integrity.py \
-        tests/test_quant_calibration.py -q -m "not slow"
+        tests/test_quant_calibration.py tests/test_mem_lint.py \
+        -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"
 

@@ -30,6 +30,9 @@ from . import comm_passes   # noqa: F401  registers the comm passes
 from .comm_passes import (CommEntry, extract_comm_plan, lint_comm,
                           lint_comm_source, plan_digest, plan_wire_gb,
                           scan_rank_divergence)
+from . import mem_passes    # noqa: F401  registers the mem passes
+from .mem_passes import (MemTimeline, detect_capacity, extract_liveness,
+                         lint_mem, timeline_peak_gb, trainer_timeline)
 from . import program_passes  # noqa: F401  registers program-bypass
 from .program_passes import lint_program_source, scan_program_bypass
 from .baseline import (BASELINE_PATH, baseline_entry, check_baseline,
@@ -45,6 +48,8 @@ __all__ = [
     "replay_log",
     "CommEntry", "extract_comm_plan", "lint_comm", "lint_comm_source",
     "plan_digest", "plan_wire_gb", "scan_rank_divergence",
+    "MemTimeline", "detect_capacity", "extract_liveness", "lint_mem",
+    "timeline_peak_gb", "trainer_timeline", "mem_passes",
     "BASELINE_PATH", "baseline_entry", "check_baseline", "load_baseline",
     "run_gate", "write_baseline", "symbol_passes", "jaxpr_passes",
     "concurrency", "comm_passes", "program_passes",

@@ -20,6 +20,7 @@ from .core import (ERROR, INFO, WARN, Finding, GraphPass, PassContext,
                    register_pass)
 
 __all__ = ["iter_eqns", "iter_eqns_scoped", "layer_of_eqn",
+           "scan_carried_invars",
            "F64WideningPass",
            "HostCallbackPass", "DonationPass", "GatherScatterPass",
            "ReplicatedOptStatePass", "ServeShapeBucketPass",
@@ -236,6 +237,30 @@ class HostCallbackPass(GraphPass):
         return out
 
 
+def scan_carried_invars(jx) -> set:
+    """``id()``s of top-level invars threaded through a ``lax.scan``
+    carry whose updated value is returned (directly or via the scan's
+    carry output).  Such a buffer is donated INTO the scan — XLA
+    aliases the carry in place across iterations (the grad-accum
+    path threads params/opt_state exactly this way), so donation
+    analysis must count it as donated even when the pjit-level
+    ``donated_invars`` flag is absent."""
+    jx = getattr(jx, "jaxpr", jx)
+    carried = set()
+    for eqn in jx.eqns:
+        if eqn.primitive.name != "scan":
+            continue
+        try:
+            nc = int(eqn.params.get("num_consts", 0))
+            ncar = int(eqn.params.get("num_carry", 0))
+        except (TypeError, ValueError):
+            continue
+        for v in eqn.invars[nc:nc + ncar]:
+            if not hasattr(v, "val"):
+                carried.add(id(v))
+    return carried
+
+
 @register_pass
 class DonationPass(GraphPass):
     """Large persistent-state buffers not donated to the step.
@@ -245,7 +270,10 @@ class DonationPass(GraphPass):
     non-donated state buffer doubles its HBM footprint and forces a
     copy.  Runs only when the caller supplied donation metadata (the
     pjit ``donated_invars`` plus a pytree-path label per invar); batch
-    inputs are exempt — they are fresh every step by design.
+    inputs are exempt — they are fresh every step by design.  A state
+    buffer threaded through a ``lax.scan`` carry (the grad-accum
+    microbatch loop) is donated into the scan — XLA aliases the carry
+    in place — and is exempt too (:func:`scan_carried_invars`).
     """
 
     name = "donation"
@@ -259,12 +287,14 @@ class DonationPass(GraphPass):
             return []
         min_bytes = int(ctx.config.get("donation_min_bytes", 1 << 20))
         jx = getattr(ctx.jaxpr, "jaxpr", ctx.jaxpr)
+        carried = scan_carried_invars(jx)
         out = []
         offenders = []
         total = 0
         for var, donated, label in zip(jx.invars, ctx.donated_invars,
                                        ctx.invar_labels):
-            if donated or not label.startswith(self._STATE):
+            if donated or id(var) in carried \
+                    or not label.startswith(self._STATE):
                 continue
             aval = getattr(var, "aval", None)
             if aval is None or not hasattr(aval, "dtype"):

@@ -25,7 +25,8 @@ from ..base import MXNetError
 from .core import (ERROR, INFO, Finding, GraphView, LintReport, PassContext,
                    annotate, run_passes)
 
-__all__ = ["lint_symbol", "lint_json", "lint_trainer", "lint_server"]
+__all__ = ["lint_symbol", "lint_json", "lint_trainer", "lint_server",
+           "step_invar_metadata"]
 
 
 def lint_symbol(sym, shapes: Optional[Dict[str, tuple]] = None,
@@ -162,37 +163,22 @@ _STEP_ARG_LABELS_SENTINEL = ("params", "aux", "opt_state", "sentinel",
                              "batch", "lr", "t", "key")
 
 
-def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
-                 input_dtypes: Optional[Dict[str, Any]] = None,
-                 only=None) -> LintReport:
-    """Lint a bound+initialized Trainer's fused step: trace
-    ``trainer._step_fn`` to its pjit jaxpr, recover ``donated_invars``
-    and a pytree-path label per invar, and run the jaxpr passes (the
-    donation pass only activates on this path — it needs to know which
-    invars are persistent state vs fresh batch inputs).
-
-    ``input_dtypes`` sets the traced batch dtypes (name -> dtype) so
-    the lint trace matches the program an int-token or uint8-pipeline
-    model actually runs; unlisted inputs trace as float32."""
+def step_invar_metadata(trainer, closed, args):
+    """``(jaxpr, donated_invars, invar_labels, invar_shardings)`` for a
+    Trainer's traced fused step: unwrap the single top-level pjit to
+    the program whose invars carry donation flags, label every invar
+    with its pytree path (``params['fc1_weight']``...), and read the
+    LIVE committed sharding of each persistent-state leaf.  Shared by
+    :func:`lint_trainer` (donation/zero passes) and the memory
+    analyzer (``mem_passes.trainer_timeline`` — per-chip byte
+    pricing), so both judge the SAME program.  Any layout surprise
+    returns ``(closed, None, None, None)`` — metadata-consuming
+    passes deactivate instead of mislabeling."""
     import jax
 
-    if trainer._step_fn is None or trainer.params is None:
-        raise MXNetError("lint_trainer needs a bound, initialized Trainer "
-                         "(call bind() + init_params() first)")
     sent = getattr(trainer, "_sent", None)
-    args = trainer.abstract_step_args(input_dtypes)
     arg_labels = _STEP_ARG_LABELS if sent is None \
         else _STEP_ARG_LABELS_SENTINEL
-    report = LintReport(model="trainer-step")
-    try:
-        # x64 trace (Trainer.step_jaxpr): an f64 cast must APPEAR in
-        # the jaxpr instead of being silently truncated (both jaxpr
-        # entry points must give one verdict for one hazard)
-        closed = trainer.step_jaxpr(input_dtypes, x64=True)
-    except Exception as e:  # noqa: BLE001
-        report.extend([Finding("trace-failed", ERROR, "<step>", "<step>",
-                               "tracing the fused step failed: %s" % e)])
-        return report
     jaxpr, donated, labels, shardings = closed, None, None, None
     eqns = closed.jaxpr.eqns
     if len(eqns) == 1 and eqns[0].primitive.name == "pjit":
@@ -207,7 +193,7 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
         # live device shardings for the persistent-state invars (the
         # batch/lr/t/key tail has no committed layout: None) — the
         # zero-opt-state pass reads these to spot replicated state on a
-        # data mesh
+        # data mesh; the mem analyzer to price per-chip bytes exactly
         state_args = (trainer.params, trainer.aux, trainer.opt_state) + \
             (() if sent is None else (sent,))
         state_shards = [getattr(v, "sharding", None)
@@ -217,7 +203,39 @@ def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
         inner_n = len(getattr(jaxpr, "jaxpr", jaxpr).invars)
         if donated is not None and (len(donated) != inner_n
                                     or len(labels) != inner_n):
-            donated, labels, shardings = None, None, None  # layout surprise
+            jaxpr = closed
+            donated, labels, shardings = None, None, None
+    return jaxpr, donated, labels, shardings
+
+
+def lint_trainer(trainer, config: Optional[Dict[str, Any]] = None,
+                 input_dtypes: Optional[Dict[str, Any]] = None,
+                 only=None) -> LintReport:
+    """Lint a bound+initialized Trainer's fused step: trace
+    ``trainer._step_fn`` to its pjit jaxpr, recover ``donated_invars``
+    and a pytree-path label per invar, and run the jaxpr passes (the
+    donation pass only activates on this path — it needs to know which
+    invars are persistent state vs fresh batch inputs).
+
+    ``input_dtypes`` sets the traced batch dtypes (name -> dtype) so
+    the lint trace matches the program an int-token or uint8-pipeline
+    model actually runs; unlisted inputs trace as float32."""
+    if trainer._step_fn is None or trainer.params is None:
+        raise MXNetError("lint_trainer needs a bound, initialized Trainer "
+                         "(call bind() + init_params() first)")
+    args = trainer.abstract_step_args(input_dtypes)
+    report = LintReport(model="trainer-step")
+    try:
+        # x64 trace (Trainer.step_jaxpr): an f64 cast must APPEAR in
+        # the jaxpr instead of being silently truncated (both jaxpr
+        # entry points must give one verdict for one hazard)
+        closed = trainer.step_jaxpr(input_dtypes, x64=True)
+    except Exception as e:  # noqa: BLE001
+        report.extend([Finding("trace-failed", ERROR, "<step>", "<step>",
+                               "tracing the fused step failed: %s" % e)])
+        return report
+    jaxpr, donated, labels, shardings = \
+        step_invar_metadata(trainer, closed, args)
     lint_cfg = dict(config or {})
     lint_cfg.setdefault("data_axis_size", trainer._data_axis_size())
     lint_cfg.setdefault("zero", trainer.zero)

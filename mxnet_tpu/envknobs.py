@@ -140,6 +140,9 @@ KNOBS: Dict[str, _Knob] = dict((
     _k("MXTPU_SERVE_PRECISION", "str", "auto", "serving",
        "tenant precision tier: auto|float32|bfloat16|int8 "
        "(int8 requires a quantized symbol; see quantization.md)"),
+    _k("MXTPU_SERVE_MEM_BUDGET", "int", 0, "serving",
+       "per-chip byte budget for memory-aware tenant admission "
+       "(0 = off; predicted weights + worst-bucket peak must fit)"),
     # --- quantization --------------------------------------------------
     _k("MXTPU_QUANT_MODE", "str", "minmax", "quant",
        "activation calibration mode: minmax|percentile"),
@@ -204,6 +207,12 @@ KNOBS: Dict[str, _Knob] = dict((
        "comm-lint baseline path override"),
     _k("MXTPU_COMM_TOLERANCE_PCT", "float", 3.0, "analysis",
        "comm-budget gate tolerance"),
+    _k("MXTPU_MEM_BASELINE", "str", None, "analysis",
+       "mem-lint baseline path override"),
+    _k("MXTPU_MEM_TOLERANCE_PCT", "float", 5.0, "analysis",
+       "mem-budget gate / bench drift tolerance"),
+    _k("MXTPU_HBM_BYTES", "str", None, "analysis",
+       "per-chip HBM capacity override for the mem-capacity gate"),
     # --- bench / CI ----------------------------------------------------
     _k("MXTPU_BENCH_PIPELINE_STEPS", "int", 24, "bench",
        "timed pipeline window length"),

@@ -1777,6 +1777,23 @@ class Trainer:
                     repeat=self.grad_accum, source="spmd"))
         return plan
 
+    def mem_timeline(self, input_dtypes: Optional[Dict] = None):
+        """The fused step's predicted buffer-liveness timeline
+        (``analysis.mem_passes.MemTimeline``): per-chip peak bytes
+        under this trainer's sharding plan, the argmax program point,
+        and the per-layer breakdown — the static capacity answer to
+        "does this config fit before I run it".  Pure
+        ``jax.make_jaxpr``; no device execution."""
+        from ..analysis import mem_passes
+        return mem_passes.trainer_timeline(self, input_dtypes)
+
+    def predicted_peak_bytes(self,
+                             input_dtypes: Optional[Dict] = None) -> int:
+        """Predicted per-chip peak HBM bytes of one fused step (the
+        ``mem_timeline`` peak) — what autotune's feasibility surrogate
+        and the serving admission ledger consume."""
+        return int(self.mem_timeline(input_dtypes).peak_bytes_per_chip)
+
     def get_opt_states(self) -> bytes:
         """Serialize (num_update, optimizer state pytree[, sentinel
         state]) — the fused analog of ``Updater.get_states`` (reference

@@ -199,6 +199,32 @@ class CompiledForward(CompiledProgram):
         a_sds = {n: _wsds(v) for n, v in aux.items()}
         return self.aot(p_sds, a_sds, sds, self._rng)
 
+    def forward_jaxpr(self, params, aux, batch_shapes: Dict[str, tuple],
+                      batch_dtypes: Optional[Dict] = None,
+                      batch_shardings: Optional[Dict] = None):
+        """Trace (never compile or execute) the forward at one input
+        signature and return its ClosedJaxpr — the program the static
+        analyzers walk (``analysis.extract_liveness`` prices a bucket's
+        activation peak from it before the server admits the tenant).
+        Same aval construction as :meth:`aot_compile`, so the analyzed
+        program is the one the hot path runs."""
+        batch_dtypes = batch_dtypes or {}
+        batch_shardings = batch_shardings or {}
+        sds = {n: jax.ShapeDtypeStruct(
+            tuple(s), np.dtype(batch_dtypes.get(n, np.float32)),
+            sharding=batch_shardings.get(n))
+            for n, s in batch_shapes.items()}
+
+        def _wsds(v):
+            sh = getattr(v, "sharding", None)
+            committed = getattr(v, "_committed", False)
+            return jax.ShapeDtypeStruct(
+                v.shape, v.dtype, sharding=sh if committed else None)
+
+        p_sds = {n: _wsds(v) for n, v in params.items()}
+        a_sds = {n: _wsds(v) for n, v in aux.items()}
+        return jax.make_jaxpr(self.fn)(p_sds, a_sds, sds, self._rng)
+
     def run(self, params, aux, batch: Dict) -> Tuple:
         """Execute the forward.  ``batch`` maps every input name to a
         host or device array; returns the output tuple (device

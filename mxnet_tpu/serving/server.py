@@ -228,7 +228,8 @@ class _Model:
                  "label_trailing", "input_dtypes", "queue", "pending",
                  "n_outputs", "breaker", "consec_failures", "opened_at",
                  "batches", "sheds_since_batch", "lat_hist",
-                 "weight_bytes_on_device", "quant")
+                 "weight_bytes_on_device", "quant",
+                 "predicted_peak_bytes", "pad_ctrs")
 
     def __init__(self, name, symbol, cf, params, aux, example_shapes,
                  label_trailing, input_dtypes, n_outputs):
@@ -253,6 +254,11 @@ class _Model:
         self.consec_failures = 0
         self.opened_at = None
         self.batches = 0                        # dispatched for this model
+        # static-analyzer footprint: weights + worst-bucket activation
+        # peak per chip (0 when the liveness walk could not price it);
+        # set by add_model, read by the admission ledger and stats()
+        self.predicted_peak_bytes = 0
+        self.pad_ctrs = None    # per-model rows_real/rows_padded counters
         # EWMA-shed escape hatch: consecutive sheds since the last
         # dispatched batch.  An anomalous slow batch can inflate the
         # EWMA past every deadline; without a probe, no batch would
@@ -286,6 +292,7 @@ class ModelServer:
                  breaker_k: Optional[int] = None,
                  breaker_cooldown_ms: Optional[int] = None,
                  precision: Optional[str] = None,
+                 mem_budget: Optional[int] = None,
                  plan=None):
         # --- persisted autotune plan (docs/how_to/autotune.md):
         # ``plan=`` (dict, path, or None -> MXTPU_TUNE_PLAN) supplies
@@ -354,6 +361,14 @@ class ModelServer:
             raise MXNetError("precision %r is not auto|float32|bfloat16"
                              "|int8" % (precision,))
         self.precision = precision
+        # memory-aware admission (opt-in): per-chip byte budget the
+        # tenants' predicted footprints (weights + worst-bucket
+        # activation peak, from the static liveness analyzer) must fit
+        # in.  0 disarms — add_model still records each tenant's
+        # predicted peak in stats() for the ledger.
+        self.mem_budget = int(mem_budget) if mem_budget is not None \
+            else _env_int("MXTPU_SERVE_MEM_BUDGET",
+                          splan.get("mem_budget", 0))
         self.mesh = mesh
         self._data_axis = 1
         if mesh is not None:
@@ -528,6 +543,52 @@ class ModelServer:
         # survives the burst the EWMA smooths away)
         m.lat_hist = _obs.REGISTRY.histogram(
             "%s.%s.latency_ms" % (self._obs_scope, name))
+        # per-model pad accounting (registry-backed like the server
+        # counters): rows dispatched for THIS tenant vs the rows it
+        # actually asked for — the bucket-ladder fit per model, where
+        # the server-wide padding_frac averages tenants together
+        m.pad_ctrs = _obs.CounterDict(
+            "%s.%s" % (self._obs_scope, name),
+            {"rows_real": 0, "rows_padded": 0})
+
+        # static memory footprint: weights + the worst bucket's
+        # predicted activation peak per chip, from the liveness
+        # analyzer over the SAME traced forward the hot path runs.
+        # Always recorded (stats() ledger); with mem_budget armed it
+        # gates admission — an overcommitted tenant is refused here,
+        # not discovered as an OOM at start()
+        worst = self.buckets[-1]
+        shapes = self._bucket_shapes(m, worst)
+        shardings = None
+        if self.mesh is not None:
+            from ..parallel.mesh import batch_sharding
+            shardings = {n: batch_sharding(self.mesh, len(s))
+                         for n, s in shapes.items()}
+        try:
+            from .. import analysis
+            jaxpr = cf.forward_jaxpr(params, aux, shapes, dtypes,
+                                     batch_shardings=shardings)
+            t = analysis.extract_liveness(
+                jaxpr,
+                dict(self.mesh.shape) if self.mesh is not None else {},
+                config={"batch_leading": {worst},
+                        "data_axis_size": self._data_axis})
+            m.predicted_peak_bytes = int(t.peak_bytes_per_chip)
+        except Exception:  # noqa: BLE001 — analysis must never block
+            m.predicted_peak_bytes = 0   # serving; weights still gate
+        if self.mem_budget:
+            demand = m.predicted_peak_bytes or m.weight_bytes_on_device
+            held = sum((mm.predicted_peak_bytes
+                        or mm.weight_bytes_on_device)
+                       for mm in self._models.values())
+            if held + demand > self.mem_budget:
+                raise MXNetError(
+                    "model %r refused: predicted footprint %.1f MB/chip "
+                    "(weights + worst-bucket b%d activation peak) on top "
+                    "of %.1f MB already admitted exceeds the %.1f MB "
+                    "serve memory budget (MXTPU_SERVE_MEM_BUDGET)"
+                    % (name, demand / 1e6, worst, held / 1e6,
+                       self.mem_budget / 1e6))
         self._models[name] = m
 
     def _platform(self, params):
@@ -1106,6 +1167,9 @@ class ModelServer:
             self._stats["batches"] += 1
             self._stats["rows_real"] += total
             self._stats["rows_padded"] += padded
+            if m.pad_ctrs is not None:
+                m.pad_ctrs["rows_real"] += total
+                m.pad_ctrs["rows_padded"] += padded
             occ = self._occupancy.setdefault(padded, [0, 0])
             occ[0] += 1
             occ[1] += total
@@ -1218,6 +1282,7 @@ class ModelServer:
                     "batches": m.batches,
                     "weight_bytes_on_device": m.weight_bytes_on_device,
                     "quant": m.quant,
+                    "predicted_peak_bytes": m.predicted_peak_bytes,
                 }
         # the latency EWMA lives under each CompiledForward's own lock;
         # read it AFTER releasing _cond (never nest the two) — same for
@@ -1232,6 +1297,13 @@ class ModelServer:
             # EWMA answers "what will the next batch cost", the
             # histogram answers "what did clients actually see"
             pm["latency_ms"] = mm.lat_hist.percentiles((50, 95, 99))
+            # per-model pad fit (registry-backed counters, own mutex):
+            # how many dispatched rows were bucket padding for THIS
+            # tenant — the pad-waste lint rule prices these bytes
+            pr = mm.pad_ctrs["rows_padded"] if mm.pad_ctrs else 0
+            rr = mm.pad_ctrs["rows_real"] if mm.pad_ctrs else 0
+            pm["pad_rows"] = pr - rr
+            pm["pad_frac"] = round(1.0 - rr / pr, 4) if pr else 0.0
         s["occupancy"] = occ
         s["padding_frac"] = round(
             1.0 - s["rows_real"] / s["rows_padded"], 4) \
@@ -1244,7 +1316,8 @@ class ModelServer:
                        "breaker_k": self.breaker_k,
                        "breaker_cooldown_ms": round(
                            self.breaker_cooldown_s * 1e3, 1),
-                       "precision": self.precision}
+                       "precision": self.precision,
+                       "mem_budget_bytes": self.mem_budget}
         s["buckets"] = list(self.buckets)
         # this server's namespace in the process-wide metrics registry
         # (obs.snapshot() — the surface a fleet router scrapes)

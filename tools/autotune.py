@@ -135,11 +135,22 @@ def train_space(micro=False, devices=1):
 
 # ----------------------------------------------------------------------
 # surrogates
-def train_surrogate(configs, batch=64, model="mlp"):
+def train_surrogate(configs, batch=64, model="mlp", capacity=None):
     """Score each training config with the byte cost model (compile
     only): total predicted GB moved per step = on-chip step bytes +
-    cross-chip gradient wire bytes.  Returns rows sorted best-first."""
+    cross-chip gradient wire bytes.  Returns rows sorted best-first.
+
+    Memory feasibility rides the same surrogate pass: each row carries
+    the static liveness ``predicted_peak_bytes`` (tools/mem_lint.py's
+    model), and a config whose peak exceeds ``capacity`` (default: the
+    detected per-chip HBM / ``MXTPU_HBM_BYTES``) is marked
+    ``mem_feasible: False`` and sorted LAST — it is never adopted and
+    never gets a timed window: a config that OOMs cannot win a
+    wall-clock race it cannot finish."""
     from tools.step_breakdown import cost_model
+    if capacity is None:
+        from mxnet_tpu.analysis import detect_capacity
+        capacity = detect_capacity()
     rows = []
     for cfg in configs:
         cm = cost_model({"model": model, "batch": batch,
@@ -150,12 +161,17 @@ def train_surrogate(configs, batch=64, model="mlp"):
                          "grad_accum": cfg.get("grad_accum"),
                          "grad_dtype": cfg.get("grad_dtype")})
         score = cm["gb_per_step"] + cm["grad_comm_gb_per_step"]
+        peak = int(cm.get("predicted_peak_bytes") or 0)
+        feasible = not (capacity and peak and peak > int(capacity))
         rows.append({"config": dict(cfg), "surrogate_gb": round(score, 6),
                      "gb_per_step": cm["gb_per_step"],
                      "grad_comm_gb_per_step": cm["grad_comm_gb_per_step"],
                      "opt_state_bytes_per_chip":
-                         cm["opt_state_bytes_per_chip"]})
-    rows.sort(key=lambda r: r["surrogate_gb"])
+                         cm["opt_state_bytes_per_chip"],
+                     "predicted_peak_bytes": peak,
+                     "mem_feasible": feasible})
+    rows.sort(key=lambda r: (0 if r["mem_feasible"] else 1,
+                             r["surrogate_gb"]))
     return rows
 
 
@@ -290,6 +306,10 @@ def timed_serve_trial(sym, wargs, waux, example, cfg, payloads,
                 timeout_ms=deadline_ms)
             server.add_model("m", sym, wargs, waux,
                              input_shapes={"data": example})
+            # static worst-bucket footprint (the admission ledger's
+            # figure) — recorded into every corpus row so the corpus
+            # can answer "what would this config cost in HBM" offline
+            peak_bytes = server._models["m"].predicted_peak_bytes
             with server:
                 for _ in range(windows):
                     run = overload_run(server, payloads, rate_rps,
@@ -310,7 +330,8 @@ def timed_serve_trial(sym, wargs, waux, example, cfg, payloads,
                 "goodput_rps": best.get("goodput_rps", 0),
                 "shed_rate": best.get("shed_rate", 0),
                 "program_compiles": delta["compiles"],
-                "program_loads": delta["loads"]}
+                "program_loads": delta["loads"],
+                "predicted_peak_bytes": peak_bytes}
     for k in ("p50_ms", "p99_ms"):
         if k in best:
             measured[k] = best[k]
@@ -318,6 +339,7 @@ def timed_serve_trial(sym, wargs, waux, example, cfg, payloads,
         row = {k: run.get(k) for k in
                ("p50_ms", "p99_ms", "goodput_rps", "shed_rate",
                 "completed_in_deadline", "requests")}
+        row["predicted_peak_bytes"] = peak_bytes
         if i == 0:
             # the delta spans server construction + every window; all
             # compiles/loads happen before window 0 runs, so only its
@@ -379,10 +401,15 @@ def timed_train_trial(sym, cfg, batch=64, steps=40, corpus=None,
                 t.step(feed)
             jax.block_until_ready((t.params, t.opt_state))
             elapsed = time.perf_counter() - t0
+    try:
+        peak_bytes = t.predicted_peak_bytes()
+    except Exception:  # noqa: BLE001 — analysis gap must not void the
+        peak_bytes = 0  # timing that already ran
     measured = {"img_per_sec": round(batch * steps / elapsed, 1),
                 "step_ms": round(elapsed / steps * 1e3, 3),
                 "program_compiles": delta["compiles"],
-                "program_loads": delta["loads"]}
+                "program_loads": delta["loads"],
+                "predicted_peak_bytes": peak_bytes}
     tuneplan.append_corpus(
         {"kind": "train", "tool": "autotune", "label": label,
          "config": dict(cfg), "batch": batch, "steps": steps,
@@ -442,11 +469,17 @@ def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
         # the default train knobs and tune only the serving side.
         t_rows, t_default, t_best = [], None, None
         train_timed = {}
+        mem_skipped = 0
         adopted_train = dict(TRAIN_DEFAULTS)
         if network == "mlp":
             tspace = train_space(micro=micro,
                                  devices=len(jax.devices()))
             t_rows = train_surrogate(tspace)
+            # memory-infeasible configs (static peak past the per-chip
+            # capacity) sorted last by the surrogate: counted here,
+            # never timed, never adopted
+            mem_skipped = sum(1 for r in t_rows
+                              if not r.get("mem_feasible", True))
             t_default = next(r for r in t_rows
                              if r["config"] == TRAIN_DEFAULTS)
             t_best = t_rows[0]
@@ -463,7 +496,8 @@ def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
                 train_timed["default"] = timed_train_trial(
                     sym, TRAIN_DEFAULTS, corpus=corpus,
                     label="train:default")
-                if t_best["config"] != TRAIN_DEFAULTS:
+                if t_best["config"] != TRAIN_DEFAULTS \
+                        and t_best.get("mem_feasible", True):
                     train_timed["winner"] = timed_train_trial(
                         sym, t_best["config"], corpus=corpus,
                         label="train:winner")
@@ -577,6 +611,7 @@ def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
                 "train_adopted_default": adopted_train
                 == dict(TRAIN_DEFAULTS),
                 "train_timed": train_timed,
+                "train_mem_infeasible_skipped": mem_skipped,
                 "warm_recheck_compiles": recheck["program_compiles"],
                 "warm_recheck_loads": recheck["program_loads"],
             },
@@ -637,6 +672,7 @@ def run_tune(network="mlp", micro=False, top_k=2, seed=0, out=None,
             "goodput_default_rps": g_base,
             "goodput_winner_rps": g_win,
             "warm_recheck_compiles": recheck["program_compiles"],
+            "train_mem_infeasible_skipped": mem_skipped,
         }
         if ratchet:
             _ratchet_infer_bench(ratchet, plan, summary)

@@ -417,21 +417,12 @@ _COST_CONFIG_DEFAULTS = {
 }
 
 
-def cost_model(config=None, **overrides):
-    """``cost_model(config) -> {"gb_per_step", ...}`` — the importable
-    training-side surrogate: build the fused Trainer for ``config``,
-    compile (never execute) its step, and return the XLA cost-model
-    bytes/flops.  Config knobs: ``model`` (``mlp`` — CPU-tier seconds —
-    or ``resnet-50``), ``batch``, ``image`` (resnet), ``num_classes``,
-    ``devices`` (data-mesh degree over the local devices; >1 enables
-    the zero/grad_dtype corners), and the trainer knobs
-    ``compute_dtype``/``dtype_policy``/``remat``/``zero``/
-    ``grad_accum``/``grad_dtype``.
-
-    A repeated config against a warm ``MXTPU_PROGRAM_CACHE`` re-uses
-    the persisted executable, so the dominant cost — tracing — is paid
-    once per distinct config, ever (docs/how_to/compiled_programs.md).
-    """
+def build_cost_trainer(config=None, **overrides):
+    """Build the fused Trainer + concrete batch for a cost/surrogate
+    config — the ONE workload constructor :func:`cost_model` (XLA byte
+    accounting) and the ``--live`` liveness view share, so the two
+    never describe different programs.  Returns ``(trainer,
+    batch_vals, cfg)``."""
     cfg = dict(_COST_CONFIG_DEFAULTS)
     given = dict(config or {}, **overrides)
     unknown = sorted(set(given) - set(cfg))
@@ -502,13 +493,41 @@ def cost_model(config=None, **overrides):
                             .astype(np.float32)),
         "softmax_label": jnp.asarray(
             rng.randint(0, ncls, (batch,)).astype(np.float32))}
+    return t, batch_vals, cfg
+
+
+def cost_model(config=None, **overrides):
+    """``cost_model(config) -> {"gb_per_step", ...}`` — the importable
+    training-side surrogate: build the fused Trainer for ``config``,
+    compile (never execute) its step, and return the XLA cost-model
+    bytes/flops.  Config knobs: ``model`` (``mlp`` — CPU-tier seconds —
+    or ``resnet-50``), ``batch``, ``image`` (resnet), ``num_classes``,
+    ``devices`` (data-mesh degree over the local devices; >1 enables
+    the zero/grad_dtype corners), and the trainer knobs
+    ``compute_dtype``/``dtype_policy``/``remat``/``zero``/
+    ``grad_accum``/``grad_dtype``.
+
+    A repeated config against a warm ``MXTPU_PROGRAM_CACHE`` re-uses
+    the persisted executable, so the dominant cost — tracing — is paid
+    once per distinct config, ever (docs/how_to/compiled_programs.md).
+    """
+    t, batch_vals, cfg = build_cost_trainer(config, **overrides)
     sc = step_cost(t, batch_vals)
+    # static liveness peak (trace-only, no compile): the memory-
+    # feasibility axis of the surrogate — bytes MOVED (gb_per_step)
+    # says how fast a config is, bytes RESIDENT says whether it runs
+    # at all (tools/mem_lint.py; autotune prunes on it)
+    try:
+        peak = t.predicted_peak_bytes()
+    except Exception:  # noqa: BLE001 — the surrogate must not die
+        peak = 0       # on an analyzer gap; 0 = "unknown, don't prune"
     return {"gb_per_step": round(sc["gb_per_step"], 6),
             "tflop_per_step": round(sc["tflop_per_step"], 6),
             "bytes": sc["bytes"], "flops": sc["flops"],
             "opt_state_bytes_per_chip": t.opt_state_bytes_per_chip(),
             "grad_comm_gb_per_step": round(
                 t.grad_comm_bytes_per_step() / 1e9, 6),
+            "predicted_peak_bytes": peak,
             "config": {k: v for k, v in cfg.items()}}
 
 
@@ -694,6 +713,41 @@ def _parse_overlap_arg(spec):
 
 
 # ----------------------------------------------------------------------
+# liveness view (the RESIDENT-bytes half of the step accounting: the
+# roofline table above says where the bytes MOVE, this says where they
+# SIT at the predicted peak — tools/mem_lint.py, same walker)
+def _parse_live_arg(spec):
+    """``model=mlp,batch=64,devices=2,remat=dots`` -> cost config."""
+    cfg = {}
+    for item in filter(None, (spec or "").split(",")):
+        key, eq, v = item.partition("=")
+        if not eq:
+            raise ValueError("bad live item %r (want key=value)" % item)
+        try:
+            v = int(v)
+        except ValueError:
+            pass
+        cfg[key.strip()] = v
+    return cfg
+
+
+def run_live(spec):
+    """Build the trainer for the spec'd cost config (the SAME
+    constructor the surrogate compiles) and print the buffer-liveness
+    top-10 peak contributors from the static timeline."""
+    t, _, cfg = build_cost_trainer(_parse_live_arg(spec))
+    tl = t.mem_timeline()
+    knobs = {k: v for k, v in cfg.items()
+             if v not in (None,) and k != "num_classes"}
+    print("liveness[%s]: predicted peak %.6f GB/chip at %s "
+          "(%d program points)"
+          % (" ".join("%s=%s" % kv for kv in sorted(knobs.items())),
+             tl.peak_bytes_per_chip / 1e9, tl.peak_point, tl.n_points))
+    print(tl.format_top(10))
+    return 0
+
+
+# ----------------------------------------------------------------------
 # machine-readable byte budget (the CI regression gate)
 def byte_budget_entry(result):
     """The budget record for one captured breakdown."""
@@ -812,11 +866,21 @@ def main(argv=None):
                          "seconds, e.g. decode=0.26,h2d=0.71,"
                          "compute=0.09,measured=0.77 (bench.py computes "
                          "the same fields live as stream_*)")
+    ap.add_argument("--live", default=None, nargs="?", const="",
+                    metavar="SPEC",
+                    help="print the static buffer-liveness top-10 peak "
+                         "contributors for a cost config (trace-only, "
+                         "no compile), e.g. --live model=mlp,batch=64,"
+                         "devices=2,remat=dots; default: the mlp tune "
+                         "workload (tools/mem_lint.py shares the model)")
     args = ap.parse_args(argv)
 
     if args.overlap:
         print(json.dumps(_parse_overlap_arg(args.overlap)))
         return 0
+
+    if args.live is not None:
+        return run_live(args.live)
 
     if args.check:
         return run_check(artifact_dir=args.artifact_dir,
