@@ -980,6 +980,47 @@ def main():
                 % (ov["max_load_factor"], ov["goodput_max_load_rps"],
                    ov["base_load_factor"], ov["goodput_base_rps"]))
 
+    # --- fleet serving (docs/how_to/serving.md "Fleet serving"): the
+    # replicated tier under its three windows — scaling (1 vs 3 paced
+    # replicas on one arrival schedule), churn (kill one mid-window,
+    # autoheal), rollout (hot weight swap mid-window).  All three
+    # verdicts are GATES: a fleet that doesn't scale, doesn't recover,
+    # or drops requests across a rollout has no fleet story.
+    # MXTPU_BENCH_FLEET=0 skips (~15 s of paced load).
+    if os.environ.get("MXTPU_BENCH_FLEET", "1") != "0":
+        fl = None
+        try:
+            from tools.serve_bench import fleet_probe
+            fl = line["fleet"] = fleet_probe(quick=True)
+        except Exception as e:                      # noqa: BLE001
+            line["fleet_error"] = str(e)
+        if fl is not None:
+            if not fl["scaling_ok"]:
+                raise RuntimeError(
+                    "fleet scaling gate FAILED: %s replicas reached "
+                    "%.1f rps vs %.1f rps single (%sx < 2.2x) — see "
+                    "INFER_BENCH.json 'fleet'"
+                    % (fl["replicas"], fl["fleet_goodput_rps"],
+                       fl["single_goodput_rps"], fl["fleet_scaling_x"]))
+            if not fl["recovery_ok"]:
+                raise RuntimeError(
+                    "fleet churn gate FAILED: goodput after the kill "
+                    "recovered to %sx the steady state (< 0.9x) — "
+                    "segments %s" % (fl["churn"]["recovery_ratio"],
+                                     fl["churn"]["segment_goodput_rps"]))
+            if fl["rollout"]["dropped"] or fl["rollout"]["rolled_back"]:
+                raise RuntimeError(
+                    "fleet rollout gate FAILED: dropped=%s "
+                    "rolled_back=%s — a weight roll must lose nothing"
+                    % (fl["rollout"]["dropped"],
+                       fl["rollout"]["rolled_back"]))
+            if fl["spinup_compiles"] or fl["retraces"]:
+                raise RuntimeError(
+                    "fleet warm-start gate FAILED: spinup_compiles=%s "
+                    "retraces=%s (every fleet spin-up, heal and swap "
+                    "must be compile-free)"
+                    % (fl["spinup_compiles"], fl["retraces"]))
+
     # --- tune-plan A/B (docs/how_to/autotune.md): when a persisted
     # TUNE_PLAN.json exists (checked in at the repo root, or pointed at
     # via MXTPU_TUNE_PLAN), A/B its serving config against the built-in

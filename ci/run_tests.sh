@@ -252,6 +252,7 @@ timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 MXTPU_OBS=1 \
         tests/test_stream_pipeline.py tests/test_obs.py \
         tests/test_elastic.py tests/test_integrity.py \
         tests/test_quant_calibration.py tests/test_mem_lint.py \
+        tests/test_fleet.py \
         -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"
@@ -285,6 +286,18 @@ stage "serving overload suite (admission control / breaker / drain / supervision
 # hang the suite — docs/how_to/serving.md "Overload & degradation"
 timeout -k 10 420 env JAX_PLATFORMS=cpu \
     python -m pytest tests/test_serving_overload.py -q
+
+stage "fleet serving suite (stats routing / failover / elastic replicas / rollout)"
+# the replicated tier over ModelServer: p2c-vs-rr routing on the paced
+# skewed fixture, failover on breaker-open and replica death, elastic
+# shrink + warm autoheal (zero spin-up compiles), serve-role membership
+# records, and the zero-downtime weight rollout (zero dropped requests,
+# canary rollback restores the old weights, checkpoint watcher).  HARD
+# timeout: a wedged drain or a rollout that never converges must FAIL
+# this stage, not hang the suite — docs/how_to/serving.md "Fleet
+# serving"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_fleet.py -q
 
 stage "state-integrity suite (fingerprint / replica vote / verified rollback)"
 # the silent-data-corruption defense: on-device checksum determinism,
@@ -325,12 +338,13 @@ timeout -k 10 420 env JAX_PLATFORMS=cpu \
 
 stage "unit tests (virtual 8-device CPU mesh)"
 # test_dist.py re-runs the launcher/consistency scripts below;
-# test_elastic.py, test_integrity.py, test_obs.py,
+# test_elastic.py, test_fleet.py, test_integrity.py, test_obs.py,
 # test_quant_calibration.py, test_resilience.py, test_serving.py,
 # test_serving_overload.py, test_stream_pipeline.py and
 # test_zero_accum.py already ran as their own stages above
 python -m pytest tests/ -x -q --ignore=tests/test_dist.py \
     --ignore=tests/test_elastic.py \
+    --ignore=tests/test_fleet.py \
     --ignore=tests/test_integrity.py \
     --ignore=tests/test_obs.py \
     --ignore=tests/test_quant_calibration.py \

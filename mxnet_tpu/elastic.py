@@ -82,7 +82,16 @@ COMM_PLAN_UNTRACED = "untraced"
 _INCARNATION_SLACK_S = 1.0
 
 
-def membership_path(directory: str) -> str:
+def membership_path(directory: str, role: str = "") -> str:
+    """The membership record for ``role``.  The empty role keeps the
+    historical ``membership.json`` (the training world); a named role
+    (``role="serve"`` — the serving fleet's replica membership) gets
+    its own ``membership-<role>.json``, so a fleet and a co-resident
+    training job can publish epochs in one coordination directory
+    without clobbering each other's records (the health.py stamp-file
+    role prefixes are the same contract one layer down)."""
+    if role:
+        return os.path.join(directory, "membership-%s.json" % role)
     return os.path.join(directory, _MEMBERSHIP_FILE)
 
 
@@ -117,7 +126,8 @@ class Membership:
         return "Membership(epoch=%d, world=%s)" % (self.epoch, self.world)
 
 
-def read_membership(directory: str, num_workers: int) -> Membership:
+def read_membership(directory: str, num_workers: int,
+                    role: str = "") -> Membership:
     """The current membership record; epoch 1 over all ranks when none
     has been published (the implicit founding epoch)."""
     if _tsan.TSAN:
@@ -126,7 +136,7 @@ def read_membership(directory: str, num_workers: int) -> Membership:
             reason="atomic tmp+rename commit; readers see a whole "
                    "record or the previous one, never a torn write")
     try:
-        with open(membership_path(directory)) as f:
+        with open(membership_path(directory, role)) as f:
             raw = json.load(f)
         return Membership(raw["epoch"], raw["world"],
                           raw.get("num_workers", num_workers),
@@ -137,7 +147,8 @@ def read_membership(directory: str, num_workers: int) -> Membership:
         return Membership(1, list(range(num_workers)), num_workers)
 
 
-def _write_membership(directory: str, mem: Membership) -> None:
+def _write_membership(directory: str, mem: Membership,
+                      role: str = "") -> None:
     """Atomic, fsync'd commit of the membership record — the same
     tmp+rename recipe as the checkpoint manifests (``model._commit_file``
     is not reused verbatim: a fixed ``.tmp`` name would let two racing
@@ -147,7 +158,7 @@ def _write_membership(directory: str, mem: Membership) -> None:
             "elastic.membership_record", lockfree=True,
             reason="atomic tmp+rename commit; readers see a whole "
                    "record or the previous one, never a torn write")
-    path = membership_path(directory)
+    path = membership_path(directory, role)
     tmp = "%s.tmp.%d" % (path, os.getpid())
     with open(tmp, "w") as f:
         json.dump(mem.to_dict(), f, indent=1, sort_keys=True)

@@ -143,6 +143,33 @@ KNOBS: Dict[str, _Knob] = dict((
     _k("MXTPU_SERVE_MEM_BUDGET", "int", 0, "serving",
        "per-chip byte budget for memory-aware tenant admission "
        "(0 = off; predicted weights + worst-bucket peak must fit)"),
+    _k("MXTPU_SERVE_PACE_RPS", "float", 0.0, "serving",
+       "per-replica service pacing in rows/s (0 = off) — emulates a "
+       "fixed per-chip capacity for fleet drills on the CPU tier"),
+    # --- fleet serving -------------------------------------------------
+    _k("MXTPU_ROUTER_POLICY", "str", "p2c", "fleet",
+       "replica placement policy: p2c|least|rr"),
+    _k("MXTPU_ROUTER_RETRIES", "int", 2, "fleet",
+       "failover retries on a refused submit (next-best replica)"),
+    _k("MXTPU_FLEET_REPLICAS", "int", 3, "fleet",
+       "fleet size (target replica count; autoheal grows back to it)"),
+    _k("MXTPU_FLEET_CHECK_S", "float", 0.2, "fleet",
+       "fleet monitor scan period (crash + heartbeat-lapse detection)"),
+    _k("MXTPU_FLEET_HB_TIMEOUT_S", "float", 5.0, "fleet",
+       "serve-role heartbeat liveness timeout"),
+    _k("MXTPU_FLEET_AUTOHEAL", "bool", True, "fleet",
+       "respawn dead replicas back to the target count"),
+    _k("MXTPU_FLEET_DRAIN_S", "float", 5.0, "fleet",
+       "per-replica drain budget on rollout swap / fleet stop"),
+    _k("MXTPU_FLEET_CANARY_N", "int", 8, "fleet",
+       "canary requests per rollout swap (0 = gate off)"),
+    _k("MXTPU_FLEET_MIN_AGREE", "float", 0.9, "fleet",
+       "rollout gate: min top-1 agreement of new vs old weights"),
+    _k("MXTPU_FLEET_CANARY_LAT_X", "float", 50.0, "fleet",
+       "rollout gate: canary p50 ceiling as a multiple of the old "
+       "batch EWMA"),
+    _k("MXTPU_FLEET_ROLLOUT_POLL_S", "float", 2.0, "fleet",
+       "rollout watcher poll period over latest_verified()"),
     # --- quantization --------------------------------------------------
     _k("MXTPU_QUANT_MODE", "str", "minmax", "quant",
        "activation calibration mode: minmax|percentile"),
@@ -234,6 +261,8 @@ KNOBS: Dict[str, _Knob] = dict((
        "run the streaming-pipeline window"),
     _k("MXTPU_BENCH_TUNE", "bool", True, "bench",
        "run the tune-plan A/B probe"),
+    _k("MXTPU_BENCH_FLEET", "bool", True, "bench",
+       "run the fleet scaling/churn/rollout probe"),
     _k("MXTPU_TUNE_CORPUS", "str", None, "tuneplan",
        "TUNE_CORPUS.jsonl path override (default: repo root)"),
     _k("MXTPU_CI_FULL", "bool", False, "ci", "nightly CI tier"),

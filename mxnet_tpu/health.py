@@ -71,8 +71,20 @@ def heartbeat_dir() -> Optional[str]:
     return os.environ.get("MXTPU_HEARTBEAT_DIR") or None
 
 
-def _stamp_path(directory: str, rank: int) -> str:
+def _stamp_path(directory: str, rank: int, role: str = "") -> str:
+    """Stamp file for ``rank`` under ``role``.  The empty role keeps
+    the historical ``hb-<rank>`` names (training ranks); a named role
+    (``role="serve"`` — fleet replicas) stamps ``hb-<role>-<rank>``, so
+    a serving fleet and a co-resident training job can share one
+    coordination directory without each other's scans counting (or
+    blaming) the other population's ranks."""
+    if role:
+        return os.path.join(directory, "hb-%s-%d" % (role, rank))
     return os.path.join(directory, "hb-%d" % rank)
+
+
+def _kv_key(rank: int, role: str = "") -> str:
+    return _KV_PREFIX + ("%s-%d" % (role, rank) if role else str(rank))
 
 
 def _kv_client():
@@ -89,8 +101,9 @@ class Heartbeat:
     """Background stamper for one worker's liveness."""
 
     def __init__(self, rank: int, directory: Optional[str] = None,
-                 interval: float = _DEFAULT_INTERVAL):
+                 interval: float = _DEFAULT_INTERVAL, role: str = ""):
         self.rank = rank
+        self.role = role
         self.directory = directory or heartbeat_dir()
         self._kv = _kv_client()
         self.interval = interval
@@ -155,10 +168,11 @@ class Heartbeat:
                     "health.heartbeat_stamp", lockfree=True,
                     reason="single-writer stamp file; scanners tolerate "
                            "torn reads via mtime (liveness contract)")
-            with open(_stamp_path(self.directory, self.rank), "w") as f:
+            with open(_stamp_path(self.directory, self.rank,
+                                  self.role), "w") as f:
                 f.write(stamp + "\n")
         if self._kv is not None:
-            self._kv.key_value_set(_KV_PREFIX + str(self.rank), stamp,
+            self._kv.key_value_set(_kv_key(self.rank, self.role), stamp,
                                    allow_overwrite=True)
 
     def _run(self):
@@ -190,7 +204,8 @@ def _parse_stamp(text: str):
     return wall, seq
 
 
-def _file_stamps(directory: str, num_workers: int) -> dict:
+def _file_stamps(directory: str, num_workers: int,
+                 role: str = "") -> dict:
     """Per-rank ``(wall, seq)`` evidence from the stamp files.  A stamp
     caught mid-write (empty, truncated float, interleaved garbage) or
     one that cannot be opened still counts through its mtime — a rank
@@ -203,7 +218,7 @@ def _file_stamps(directory: str, num_workers: int) -> dict:
                    "reads via mtime (liveness contract)")
     out = {}
     for rank in range(num_workers):
-        path = _stamp_path(directory, rank)
+        path = _stamp_path(directory, rank, role)
         mtime = None
         try:
             mtime = os.path.getmtime(path)
@@ -223,15 +238,24 @@ def _file_stamps(directory: str, num_workers: int) -> dict:
     return out
 
 
-def _kv_stamps(client) -> dict:
+def _kv_stamps(client, role: str = "") -> dict:
     out = {}
     try:
         rows = client.key_value_dir_get(_KV_PREFIX)
     except Exception:              # noqa: BLE001 — service down/empty
         return out
     for key, value in rows:
+        # key tail is "<rank>" (training, the empty role) or
+        # "<role>-<rank>"; a scan only counts its own role's stamps
+        tail = key.rsplit("/", 1)[-1]
+        if role:
+            if not tail.startswith(role + "-"):
+                continue
+            tail = tail[len(role) + 1:]
+        elif not tail.isdigit():
+            continue
         try:
-            rank = int(key.rsplit("/", 1)[-1])
+            rank = int(tail)
         except ValueError:
             continue
         wall, seq = _parse_stamp(value)
@@ -293,26 +317,34 @@ def _evidence_age(key, rank, wall, seq, now_wall, now_mono):
     return max(0.0, now_wall - wall)
 
 
-def rank_evidence(num_workers: int, directory: Optional[str] = None
-                  ) -> Dict[int, Optional[float]]:
+def rank_evidence(num_workers: int, directory: Optional[str] = None,
+                  role: str = "") -> Dict[int, Optional[float]]:
     """Freshest liveness-evidence age per rank in seconds (``None`` = no
     evidence on any transport — the rank has never stamped).  Scans both
     transports and takes the minimum age; returns an empty dict when no
     transport is in active use (matching :func:`dead_nodes`'s
-    no-configuration behavior)."""
+    no-configuration behavior).  ``role`` scopes the scan to one stamp
+    population (training = the empty role, ``"serve"`` = fleet
+    replicas): a role's scan never reads — and never blames — another
+    role's ranks, so both can share one coordination directory."""
     directory = directory or heartbeat_dir()
     client = _kv_client()
-    kv = _kv_stamps(client) if client is not None else {}
+    kv = _kv_stamps(client, role) if client is not None else {}
     kv_active = bool(kv)
     dir_active = bool(directory) and os.path.isdir(directory)
-    files = _file_stamps(directory, num_workers) if dir_active else {}
+    files = _file_stamps(directory, num_workers, role) \
+        if dir_active else {}
     if not kv_active and not dir_active:
         return {}
     now_wall, now_mono = time.time(), time.monotonic()
     out: Dict[int, Optional[float]] = {}
     for rank in range(num_workers):
         ages = []
-        for key, stamps in (("kv", kv), (directory, files)):
+        # the seq-progress memory is keyed by (transport, role, rank):
+        # without the role, training rank 0 and serve replica 0 in one
+        # directory would share one history slot and cross-blame
+        for key, stamps in ((("kv", role), kv),
+                            ((directory, role), files)):
             if rank not in stamps:
                 continue
             wall, seq = stamps[rank]
@@ -324,13 +356,15 @@ def rank_evidence(num_workers: int, directory: Optional[str] = None
 
 
 def dead_nodes(num_workers: int, timeout: float = 60.0,
-               directory: Optional[str] = None) -> List[int]:
+               directory: Optional[str] = None,
+               role: str = "") -> List[int]:
     """Ranks with no fresh liveness evidence on any transport within
     ``timeout`` seconds (the ``get_num_dead_node`` scan).  Empty when no
     transport is configured — matching the reference's single-process
     behavior: never declare a whole job dead on absence of
-    configuration."""
-    evidence = rank_evidence(num_workers, directory=directory)
+    configuration.  ``role`` scopes the scan (see
+    :func:`rank_evidence`)."""
+    evidence = rank_evidence(num_workers, directory=directory, role=role)
     if not evidence:
         return []
     return [rank for rank in range(num_workers)

@@ -41,6 +41,7 @@ bit-for-bit (tests/test_resilience.py asserts exactly that).
 from __future__ import annotations
 
 import glob
+import hashlib
 import json
 import logging
 import os
@@ -159,6 +160,17 @@ class CheckpointManager:
         self.attempts = int(attempts)
         self.delay = float(delay)
         self.logger = logger or logging.getLogger("mxtpu.resilience")
+        # verification cache: epoch -> (identity, verdict) where
+        # identity pins the manifest by (path, mtime_ns, size, content
+        # digest) and every listed artifact by (mtime_ns, size).  A
+        # rollout watcher polls latest_verified() every few seconds;
+        # without the cache each poll re-reads and re-hashes the full
+        # checkpoint bytes (CRC pass) AND re-fingerprints the reloaded
+        # values.  Any identity change — a new manifest, a touched or
+        # resized artifact — drops the entry and the full two-tier
+        # verification runs again; a verdict is only ever reused for
+        # the exact bytes it was computed over.
+        self._vcache = {}
         parent = os.path.dirname(os.path.abspath(self.prefix))
         if parent:
             os.makedirs(parent, exist_ok=True)
@@ -401,6 +413,60 @@ class CheckpointManager:
             record, named, logger=self.logger,
             what="checkpoint %04d" % ck.epoch)
 
+    def _verify_identity(self, epoch: int):
+        """Cache key for one epoch's verification verdict: the manifest
+        pinned by (path, mtime_ns, size, sha1-of-content) plus every
+        listed artifact pinned by (mtime_ns, size).  ``None`` when any
+        piece is unreadable — an unreadable identity is never cached
+        (the full verification pass owns the failure and its logging).
+        Returns ``(identity, manifest)`` so a cache miss does not
+        re-read the manifest it just hashed."""
+        path = self._manifest_path(epoch)
+        try:
+            st = os.stat(path)
+            with open(path, "rb") as f:
+                blob = f.read()
+            manifest = json.loads(blob)
+        except (OSError, ValueError):
+            return None, None
+        ident = [(path, st.st_mtime_ns, st.st_size,
+                  hashlib.sha1(blob).hexdigest())]
+        base = os.path.dirname(os.path.abspath(path))
+        try:
+            for name in sorted(manifest.get("files", {})):
+                fst = os.stat(os.path.join(base, name))
+                ident.append((name, fst.st_mtime_ns, fst.st_size))
+        except OSError:
+            return None, None
+        return tuple(ident), manifest
+
+    def verified(self, epoch: int) -> Optional[Checkpoint]:
+        """Both verification tiers for one epoch — artifact CRCs
+        (:meth:`verify`) then the value fingerprint
+        (:meth:`verify_fingerprint`) — memoized on the checkpoint's
+        on-disk identity (see ``_vcache``).  A hit skips the byte
+        re-hash entirely; ANY identity change (new manifest, touched or
+        byte-patched artifact) re-runs both tiers, so a checkpoint that
+        was damaged after a cached pass is still refused."""
+        ident, manifest = self._verify_identity(epoch)
+        if ident is None:
+            self._vcache.pop(epoch, None)
+            ck = self.verify(epoch)
+            return ck if ck is not None \
+                and self.verify_fingerprint(ck) else None
+        cached = self._vcache.get(epoch)
+        if cached is not None and cached[0] == ident:
+            return Checkpoint(self.prefix, epoch, manifest) \
+                if cached[1] else None
+        ck = self.verify(epoch)
+        ok = ck is not None and self.verify_fingerprint(ck)
+        # re-pin AFTER the byte reads: a file swapped mid-verification
+        # changes its identity and must not be cached under the old one
+        ident2, _ = self._verify_identity(epoch)
+        if ident2 == ident:
+            self._vcache[epoch] = (ident, ok)
+        return ck if ok else None
+
     def latest_verified(self) -> Optional[Checkpoint]:
         """Newest checkpoint that passes BOTH tiers — artifact CRCs
         (:meth:`verify`) and the value fingerprint
@@ -408,12 +474,15 @@ class CheckpointManager:
         silent-data-corruption recovery protocol: a divergence detected
         by the in-step integrity check restores from HERE, never from a
         checkpoint whose own state cannot prove it predates the
-        corruption."""
+        corruption.  Verdicts are cached per on-disk identity
+        (:meth:`verified`), so the rollout watcher's poll loop costs a
+        handful of ``stat()`` calls between checkpoint publishes
+        instead of a full re-hash of the checkpoint bytes."""
         from .model import _sweep_stale_tmp
         _sweep_stale_tmp(self.prefix)
         for epoch in reversed(self._epochs_on_disk()):
-            ck = self.verify(epoch)
-            if ck is not None and self.verify_fingerprint(ck):
+            ck = self.verified(epoch)
+            if ck is not None:
                 return ck
         return None
 
@@ -436,13 +505,13 @@ class CheckpointManager:
             return
         protect = None
         for epoch in reversed(epochs):
-            ck = self.verify(epoch)
-            if ck is not None and self.verify_fingerprint(ck):
+            if self.verified(epoch) is not None:
                 protect = epoch
                 break
         for epoch in doomed:
             if epoch == protect:
                 continue
+            self._vcache.pop(epoch, None)
             for suffix in (".params", ".states", ".manifest.json"):
                 path = "%s-%04d%s" % (self.prefix, epoch, suffix)
                 try:

@@ -12,12 +12,19 @@ buckets over the compiled forward (ROADMAP item 1).
 * :class:`~.compiled.CompiledForward` / :func:`~.compiled.compiled_forward`
   — the keyed compiled-forward cache (weights as arguments) shared by
   the server buckets and :class:`~..predictor.Predictor`.
+* :class:`~.fleet.FleetRouter` / :class:`~.fleet.ReplicaSpec` — the
+  replicated tier (ROADMAP item 4): stats-routed load balancing over N
+  replicas (power-of-two-choices on ``load_report()``), failover
+  retries, elastic shrink/heal on replica death, and zero-downtime
+  weight rollout off ``CheckpointManager.latest_verified()``.
 
 Architecture walkthrough: ``docs/how_to/serving.md``.  Load generator /
-bench: ``tools/serve_bench.py`` (INFER_BENCH.json ``serving`` section).
+bench: ``tools/serve_bench.py`` (INFER_BENCH.json ``serving`` +
+``fleet`` sections).
 """
 from .compiled import (CompiledForward, cache_stats, clear_cache,
                        compiled_forward)
+from .fleet import FleetRouter, ReplicaSpec
 from .server import (ModelServer, ServeCancelled, ServeError,
                      ServeFuture, ServeOverload, ServeTimeout,
                      ServeUnavailable)
@@ -25,4 +32,4 @@ from .server import (ModelServer, ServeCancelled, ServeError,
 __all__ = ["ModelServer", "ServeFuture", "ServeError", "ServeTimeout",
            "ServeOverload", "ServeUnavailable", "ServeCancelled",
            "CompiledForward", "compiled_forward", "cache_stats",
-           "clear_cache"]
+           "clear_cache", "FleetRouter", "ReplicaSpec"]

@@ -134,3 +134,44 @@ def test_heartbeat_registered_for_atexit_stop(tmp_path, monkeypatch):
     assert h._thread.daemon                  # can never wedge exit
     health._stop_all_at_exit()
     assert not h.active
+
+
+# ======================================================================
+# role-prefixed stamps: a serving fleet and a co-resident training job
+# share one coordination dir without cross-blaming (both directions)
+def test_role_prefixed_stamps_both_directions(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXTPU_HEARTBEAT_DIR", str(tmp_path))
+    health._reset_seq_cache()
+    train = [health.Heartbeat(r, interval=0.05) for r in range(2)]
+    serve = [health.Heartbeat(r, interval=0.05, role="serve")
+             for r in range(3)]
+    time.sleep(0.15)
+    # distinct stamp files: hb-<rank> vs hb-serve-<rank>
+    names = sorted(p.name for p in tmp_path.iterdir())
+    assert "hb-0" in names and "hb-serve-0" in names
+    # both populations read healthy through their own scans
+    assert health.dead_nodes(2, timeout=1.0) == []
+    assert health.dead_nodes(3, timeout=1.0, role="serve") == []
+    # direction 1: serve replica 2 is alive, but it is NOT a training
+    # rank — a training scan of world 3 must still blame rank 2
+    # (absence of a TRAIN stamp), not count the serve stamp as alive
+    assert health.dead_nodes(3, timeout=1.0) == [2]
+    # direction 2: serve replica 1 dies; the serve scan blames it, the
+    # training scan stays clean
+    serve[1].stop()
+    deadline = time.time() + 10.0
+    while time.time() < deadline \
+            and health.dead_nodes(3, timeout=0.3, role="serve") != [1]:
+        time.sleep(0.1)
+    assert health.dead_nodes(3, timeout=0.3, role="serve") == [1]
+    assert health.dead_nodes(2, timeout=0.3) == []
+    # and a training death never shows up in the serve scan
+    train[0].stop()
+    deadline = time.time() + 10.0
+    while time.time() < deadline \
+            and 0 not in health.dead_nodes(2, timeout=0.3):
+        time.sleep(0.1)
+    assert 0 in health.dead_nodes(2, timeout=0.3)
+    assert health.dead_nodes(3, timeout=0.3, role="serve") == [1]
+    for hb in train + serve:
+        hb.stop()

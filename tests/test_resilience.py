@@ -622,3 +622,58 @@ def test_device_upload_iter_surfaces_worker_error():
     with pytest.raises(ValueError, match="producer blew up"):
         while True:
             up.next()
+
+
+# ======================================================================
+# latest_verified() verification cache (the rollout watcher polls every
+# few seconds; a poll between publishes must not re-hash checkpoint
+# bytes — and a byte-patched artifact must STILL be refused after a hit)
+def test_latest_verified_memoizes_on_disk_identity(tmp_path, monkeypatch):
+    prefix = str(tmp_path / "vc")
+    mod = _fit_module(_train_iter(), num_epoch=2, prefix=prefix)
+    mgr = resilience.CheckpointManager(prefix)
+    ck = mgr.latest_verified()
+    assert ck is not None and ck.epoch == 2
+
+    calls = []
+    real = resilience._crc32_file
+
+    def counting_crc(path, *a, **kw):
+        calls.append(path)
+        return real(path, *a, **kw)
+
+    monkeypatch.setattr(resilience, "_crc32_file", counting_crc)
+    ck2 = mgr.latest_verified()
+    assert ck2 is not None and ck2.epoch == 2
+    assert calls == []            # verdict reused: zero bytes re-hashed
+    del mod
+
+
+def test_latest_verified_refuses_bytepatch_after_cache_hit(tmp_path):
+    prefix = str(tmp_path / "bp")
+    mod = _fit_module(_train_iter(), num_epoch=2, prefix=prefix)
+    mgr = resilience.CheckpointManager(prefix)
+    ck = mgr.latest_verified()
+    assert ck is not None and ck.epoch == 2
+    assert mgr.latest_verified().epoch == 2          # warm the cache
+    # same-size byte patch: the on-disk identity (mtime_ns) changes, so
+    # the cached PASS is dropped and the full verification re-runs
+    with open(ck.params_path, "r+b") as f:
+        f.seek(100)
+        b = f.read(1)
+        f.seek(100)
+        f.write(bytes([b[0] ^ 0xFF]))
+    ck2 = mgr.latest_verified()
+    assert ck2 is not None and ck2.epoch == 1        # patched epoch refused
+    # the refusal itself is memoized: a repeat poll is stat()-only
+    import mxnet_tpu.resilience as _r
+    counted = []
+    real = _r._crc32_file
+    try:
+        _r._crc32_file = lambda p, *a, **kw: (counted.append(p),
+                                              real(p, *a, **kw))[1]
+        assert mgr.latest_verified().epoch == 1
+        assert counted == []
+    finally:
+        _r._crc32_file = real
+    del mod

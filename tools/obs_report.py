@@ -32,6 +32,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 import numpy as np
@@ -208,18 +209,33 @@ def compile_breakdown(spans):
 
 def metrics_summary(events):
     """Fold the periodic metric-delta lines: summed counter deltas,
-    last gauge values, last histogram snapshots."""
+    last gauge values, last histogram snapshots.  Replica-scoped
+    serving counters (``serving.server<N>.*`` — each
+    :class:`~mxnet_tpu.serving.ModelServer` of a fleet counts under its
+    own registry scope) are additionally merged into a ``fleet``
+    rollup, the cross-replica sum a capacity dashboard wants next to
+    the per-replica lines."""
     counters, gauges, hists = {}, {}, {}
     for e in _export.metric_events(events):
         for k, v in (e.get("c") or {}).items():
             counters[k] = round(counters.get(k, 0) + v, 6)
         gauges.update(e.get("g") or {})
         hists.update(e.get("h") or {})
-    return {"counter_deltas": dict(sorted(counters.items())),
-            "gauges": dict(sorted(gauges.items())),
-            "histograms": {k: {kk: vv for kk, vv in h.items()
-                               if kk != "counts"}
-                           for k, h in sorted(hists.items())}}
+    fleet, replicas = {}, set()
+    for k, v in counters.items():
+        m = re.match(r"serving\.server(\d+)\.([^.]+)$", k)
+        if m:
+            replicas.add(int(m.group(1)))
+            fleet[m.group(2)] = round(fleet.get(m.group(2), 0) + v, 6)
+    out = {"counter_deltas": dict(sorted(counters.items())),
+           "gauges": dict(sorted(gauges.items())),
+           "histograms": {k: {kk: vv for kk, vv in h.items()
+                              if kk != "counts"}
+                          for k, h in sorted(hists.items())}}
+    if len(replicas) > 1:
+        out["fleet"] = {"replicas": len(replicas),
+                        "counter_deltas": dict(sorted(fleet.items()))}
+    return out
 
 
 def report(paths, tol_pct=5.0):

@@ -43,10 +43,19 @@ saturation the server sheds the excess deliberately and keeps serving
 at capacity instead of letting queues and p99 grow without bound.
 ``bench.py`` asserts it on every run.
 
-``--out INFER_BENCH.json`` merges ``serving`` and ``overload`` sections
-into the artifact (field definitions: docs/how_to/perf.md "Serving");
-``bench.py`` embeds the quick sweeps via :func:`serving_probe` /
-:func:`overload_probe`.
+**Fleet sweep** (:func:`fleet_probe`, ``--fleet``): the replicated tier
+(``FleetRouter``) under three windows — scaling (same offered load and
+arrival schedule against 1 vs N paced replicas), churn (kill one
+replica mid-window, autoheal), and a zero-downtime weight rollout
+mid-window.  Gates: ``fleet_goodput_rps >= 2.2x`` the single replica,
+last-third goodput ``>= 0.9x`` first-third after the kill, zero dropped
+requests and zero spin-up compiles across the rollout.
+
+``--out INFER_BENCH.json`` merges ``serving`` and ``overload`` (and
+``quant`` / ``fleet`` when requested) sections into the artifact (field
+definitions: docs/how_to/perf.md "Serving"); ``bench.py`` embeds the
+quick sweeps via :func:`serving_probe` / :func:`overload_probe` /
+:func:`fleet_probe`.
 """
 from __future__ import annotations
 
@@ -727,6 +736,229 @@ def obs_overhead_probe(network="mlp-wide", pairs=3, n=200, buckets=None,
     }
 
 
+# ----------------------------------------------------------------------
+def _fleet_window(fleet, payloads, rate_rps, seed, deadline_s,
+                  trigger_i=None, trigger=None):
+    """Open-loop Poisson window against a :class:`FleetRouter`, with an
+    optional mid-window ``trigger`` (kill / rollout) fired from a side
+    thread when arrival ``trigger_i`` is reached.  Returns per-arrival
+    records ``(segment, outcome, latency_s)`` — outcome ``good`` /
+    ``late`` / ``shed`` (synchronous refusal after failover retries) /
+    ``dropped`` (an accepted future that later failed) — plus the
+    segment wallclock boundaries for per-segment goodput."""
+    n = len(payloads)
+    arrivals = arrival_schedule(n, rate_rps, seed)
+    futures, shed = [None] * n, [False] * n
+    thr = None
+    t0 = time.perf_counter()
+    i = 0
+    while i < n:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            if trigger is not None and thr is None and i >= trigger_i:
+                thr = threading.Thread(target=trigger, daemon=True)
+                thr.start()
+            try:
+                futures[i] = fleet.submit({"data": payloads[i]})
+            except Exception:                      # noqa: BLE001
+                shed[i] = True                     # refused even after
+            i += 1                                 # failover retries
+        if i < n:
+            time.sleep(min(0.002, max(0.0, arrivals[i]
+                                      - (time.perf_counter() - t0))))
+    if thr is not None:
+        thr.join(timeout=120)
+    records = []
+    for k in range(n):
+        seg = min(2, 3 * k // n)
+        if shed[k]:
+            records.append((seg, "shed", None))
+            continue
+        try:
+            futures[k].result(timeout=60)
+            lat = futures[k].latency_s
+            records.append((seg, "good" if lat <= deadline_s else "late",
+                            lat))
+        except Exception:                          # noqa: BLE001
+            records.append((seg, "dropped", None))
+    elapsed = time.perf_counter() - t0
+    bounds = [arrivals[0], arrivals[n // 3], arrivals[2 * n // 3],
+              arrivals[-1]]
+    return records, bounds, elapsed
+
+
+def _segment_goodput(records, bounds):
+    """Per-arrival-third (goodput_rps, in-deadline fraction) pairs.
+    The RATE carries the Poisson draw's own variance (a third whose
+    exponential gaps ran long divides by a bigger denominator); the
+    FRACTION of offered requests served in deadline is what recovery
+    is judged on — the offered process is identical-rate across
+    segments, so fraction ratios isolate the service effect."""
+    segs = []
+    for s in range(3):
+        n = sum(1 for seg, _, _ in records if seg == s)
+        good = sum(1 for seg, out, _ in records if seg == s
+                   and out == "good")
+        dur = bounds[s + 1] - bounds[s]
+        segs.append((round(good / dur, 1) if dur > 0 else 0.0,
+                     round(good / n, 4) if n else 0.0))
+    return segs
+
+
+def fleet_probe(network="mlp", quick=True, replicas=3, pace_rps=120.0,
+                seed=0):
+    """The replicated-tier sweep: the INFER_BENCH ``fleet`` section.
+
+    Three windows against a :class:`~mxnet_tpu.serving.FleetRouter`,
+    each replica paced to ``pace_rps`` rows/s (``MXTPU_SERVE_PACE_RPS``
+    semantics: a fixed per-replica service rate, so on the 1-2 core CPU
+    tier the fleet properties measured here — scaling, failover,
+    rollout — are properties of the ROUTER, not of how many host cores
+    the replicas fight over):
+
+    * **scaling** — the same offered load (0.9x the 3-replica capacity)
+      and the same arrival schedule against ONE replica and against the
+      fleet.  The single replica is capacity-bound and sheds the rest;
+      the gate is ``fleet_goodput_rps >= 2.2x single_goodput_rps``.
+    * **churn** — moderate load (0.6x capacity), one replica killed at
+      the 1/3 mark; its in-flight futures fail fast, traffic re-spreads,
+      autoheal respawns a warm replacement.  The gate compares
+      last-third goodput to first-third: ``recovery_ratio >= 0.9``.
+    * **rollout** — same load, ``roll_weights`` fired at the 1/3 mark
+      (drain -> hot-swap -> canary per replica).  The gates:
+      ``dropped == 0`` (every accepted request completes), zero
+      retraces, and ``spinup_compiles == 0`` across every fleet
+      spin-up, heal and swap (warm starts only).
+    """
+    from mxnet_tpu.serving import FleetRouter, ReplicaSpec
+
+    sym, args, aux, example = build_model(network, seed)
+    deadline_ms = 500
+    spec = ReplicaSpec(sym, args, aux, {"data": example},
+                       server_kw=dict(buckets=[1, 2, 4, 8],
+                                      queue_cap=32, shed_policy="reject",
+                                      timeout_ms=deadline_ms,
+                                      max_wait_us=500,
+                                      pace_rps=pace_rps))
+    scale = 1 if quick else 2
+    deadline_s = deadline_ms / 1e3
+    rng = np.random.RandomState(seed + 1)
+
+    def payload_set(n):
+        return [rng.randn(1, *example).astype("f") for _ in range(n)]
+
+    # -- scaling: identical offered load + arrival schedule, 1 vs N ----
+    offered = replicas * pace_rps * 0.9
+    n_scale = int(600 * scale)
+    payloads = payload_set(n_scale)
+    arrivals = arrival_schedule(n_scale, offered, seed + 2)
+    with spec.build() as srv:          # also warms the compile caches:
+        single = overload_run(srv, payloads, offered, deadline_s,
+                              model=spec.model, arrivals=arrivals)
+        srv.assert_no_retrace()        # every later spin-up must be 0
+    spinup_compiles = 0
+    with FleetRouter(spec, n=replicas, check_interval_s=0.2,
+                     seed=seed) as fleet:
+        fleet_run = overload_run(fleet, payloads, offered, deadline_s,
+                                 arrivals=arrivals)
+        fleet.assert_no_retrace()
+        st = fleet.stats()
+        spinup_compiles += sum(r["spinup_compiles"]
+                               for r in st["replicas"].values())
+        retraces_scaling = st["merged"].get("retraces", 0)
+    scaling_x = (round(fleet_run["goodput_rps"] / single["goodput_rps"],
+                       2) if single["goodput_rps"] else None)
+
+    # -- churn: kill one replica at the 1/3 mark, autoheal ------------
+    offered_mid = replicas * pace_rps * 0.6
+    n_mid = int(540 * scale)
+    with FleetRouter(spec, n=replicas, check_interval_s=0.1,
+                     seed=seed) as fleet:
+        recs, bounds, _ = _fleet_window(
+            fleet, payload_set(n_mid), offered_mid, seed + 3, deadline_s,
+            trigger_i=n_mid // 3,
+            trigger=lambda: fleet.kill_replica(fleet.live_replicas()[0]))
+        # give autoheal until end-of-window accounting to be visible
+        segs = _segment_goodput(recs, bounds)
+        st = fleet.stats()
+        healed = len(fleet.live_replicas()) == replicas
+        spinup_compiles += sum(r["spinup_compiles"]
+                               for r in st["replicas"].values())
+        churn = {
+            "offered_rps": round(offered_mid, 1),
+            "killed_at_request": n_mid // 3,
+            "failed_fast": sum(1 for _, out, _ in recs
+                               if out == "dropped"),
+            "segment_goodput_rps": [s[0] for s in segs],
+            "segment_good_frac": [s[1] for s in segs],
+            # last third vs first third, on the in-deadline FRACTION of
+            # the identical-rate offered process (see _segment_goodput)
+            "recovery_ratio": (round(segs[2][1] / segs[0][1], 3)
+                               if segs[0][1] else None),
+            "healed": healed,
+            "epoch": fleet.epoch,
+            "failovers": st["router"]["failovers"],
+        }
+
+    # -- rollout: zero dropped requests across a full weight roll -----
+    args2 = {k: v * 1.001 for k, v in args.items()}
+    roll_res = {}
+    with FleetRouter(spec, n=replicas, check_interval_s=0.2,
+                     seed=seed) as fleet:
+        def do_roll():
+            roll_res.update(fleet.roll_weights(args2, aux, version=2,
+                                               drain_s=5.0))
+
+        recs, bounds, elapsed = _fleet_window(
+            fleet, payload_set(n_mid), offered_mid, seed + 4, deadline_s,
+            trigger_i=n_mid // 3, trigger=do_roll)
+        fleet.assert_no_retrace()
+        st = fleet.stats()
+        spinup_compiles += sum(r["spinup_compiles"]
+                               for r in st["replicas"].values())
+        good = sum(1 for _, out, _ in recs if out == "good")
+        rollout = {
+            "offered_rps": round(offered_mid, 1),
+            "rolled_at_request": n_mid // 3,
+            "requests": n_mid,
+            "completed_in_deadline": good,
+            "completed_late": sum(1 for _, out, _ in recs
+                                  if out == "late"),
+            "shed": sum(1 for _, out, _ in recs if out == "shed"),
+            "dropped": sum(1 for _, out, _ in recs
+                           if out == "dropped"),
+            "goodput_rps": round(good / elapsed, 1),
+            "swapped": roll_res.get("swapped"),
+            "rolled_back": roll_res.get("rolled_back"),
+            "version": st["version"],
+        }
+
+    return {
+        "network": network,
+        "replicas": replicas,
+        "policy": os.environ.get("MXTPU_ROUTER_POLICY", "p2c"),
+        "pace_rps_per_replica": pace_rps,
+        "deadline_ms": deadline_ms,
+        "offered_rps": round(offered, 1),
+        "single": single,
+        "fleet": fleet_run,
+        "single_goodput_rps": single["goodput_rps"],
+        "fleet_goodput_rps": fleet_run["goodput_rps"],
+        "fleet_scaling_x": scaling_x,
+        "churn": churn,
+        "rollout": rollout,
+        "spinup_compiles": spinup_compiles,
+        "retraces": int(retraces_scaling),
+        # the bench.py gates in one place
+        "scaling_ok": bool(scaling_x and scaling_x >= 2.2),
+        "recovery_ok": bool(churn["recovery_ratio"]
+                            and churn["recovery_ratio"] >= 0.9),
+        "rollout_ok": bool(rollout["dropped"] == 0
+                           and not rollout["rolled_back"]
+                           and spinup_compiles == 0),
+    }
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--network", default="mlp",
@@ -746,6 +978,9 @@ def main(argv=None):
     ap.add_argument("--quant", action="store_true",
                     help="also run the quantized-vs-f32 ranker sweep "
                          "(the INFER_BENCH 'quant' section)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="also run the replicated-tier sweep "
+                         "(the INFER_BENCH 'fleet' section)")
     args = ap.parse_args(argv)
 
     buckets = [int(b) for b in args.buckets.split(",")] \
@@ -776,6 +1011,14 @@ def main(argv=None):
         quant = quant_probe(quick=args.quick)
         quant["device"] = device
         print(json.dumps(quant, indent=1))
+    fleet = None
+    if args.fleet:
+        fleet = fleet_probe(network=args.network, quick=args.quick)
+        fleet["device"] = device
+        print(json.dumps(fleet, indent=1))
+        for gate in ("scaling_ok", "recovery_ok", "rollout_ok"):
+            if not fleet[gate]:
+                print("fleet gate FAILED: %s" % gate, file=sys.stderr)
     if args.out:
         artifact = {}
         if os.path.exists(args.out):
@@ -786,6 +1029,8 @@ def main(argv=None):
             artifact["overload"] = overload
         if quant is not None:
             artifact["quant"] = quant
+        if fleet is not None:
+            artifact["fleet"] = fleet
         with open(args.out, "w") as f:
             json.dump(artifact, f, indent=1)
             f.write("\n")
@@ -793,6 +1038,10 @@ def main(argv=None):
               % ("" if overload is None else "+overload", args.out),
               file=sys.stderr)
     if overload is not None and not overload["degradation_ok"]:
+        return 1
+    if fleet is not None and not (fleet["scaling_ok"]
+                                  and fleet["recovery_ok"]
+                                  and fleet["rollout_ok"]):
         return 1
     return 0
 
