@@ -569,6 +569,64 @@ def _program_cache_probe(timeout=240):
     }
 
 
+def _parallel_probe(timeout=900):
+    """Large-model parallelism workloads (docs/how_to/perf.md
+    "Large-model parallelism"): run ``tools/parallel_bench.py`` on the
+    virtual 8-device CPU mesh in a fresh subprocess — sparse-vs-dense
+    MoE dispatch A/B, causal-skip ring attention A/B, interleaved-vs-
+    gpipe pipeline A/B, then the composed transformer-large training
+    window and the long-context ring-attention LM window through
+    CompiledPrograms (zero-retrace gated, kill-and-resume bit-parity
+    drilled).  A second run with ``--only transformer,ringattn``
+    against the SAME ``MXTPU_PROGRAM_CACHE`` dir gates the warm
+    restart: zero compiles, loads only.  The script exits non-zero on
+    any gate failure — the probe re-raises with its tail."""
+    import shutil
+    import subprocess
+    import tempfile
+    root = os.path.dirname(os.path.abspath(__file__))
+    cdir = tempfile.mkdtemp(prefix="mxtpu-parallel-bench-")
+    env = dict(os.environ)
+    env["MXTPU_PROGRAM_CACHE"] = cdir
+    env.pop("XLA_FLAGS", None)          # the script sets its own
+    script = os.path.join(root, "tools", "parallel_bench.py")
+    steps = os.environ.get("MXTPU_BENCH_PARALLEL_STEPS", "3")
+
+    def run(argv, expect):
+        res = subprocess.run(
+            [sys.executable, script, "--steps", steps,
+             "--expect", expect] + argv,
+            env=env, cwd=root, capture_output=True, text=True,
+            timeout=timeout)
+        lines = [ln for ln in res.stdout.splitlines()
+                 if ln.startswith("PARALLEL_BENCH ")]
+        if res.returncode != 0 or not lines:
+            raise RuntimeError("parallel bench (%s) failed: %s"
+                               % (expect,
+                                  (res.stdout + res.stderr)[-800:]))
+        return json.loads(lines[-1][len("PARALLEL_BENCH "):])
+
+    try:
+        cold = run([], "cold")
+        warm = run(["--only", "transformer,ringattn"], "warm")
+    finally:
+        shutil.rmtree(cdir, ignore_errors=True)
+    return {
+        "moe": cold["moe"],
+        "ring": cold["ring"],
+        "pipeline": cold["pipeline"],
+        "transformer_large_tok_per_sec":
+            cold["transformer_large_tok_per_sec"],
+        "ringattn_tok_per_sec": cold["ringattn_tok_per_sec"],
+        "resume_bit_parity": cold["transformer"]["resume_bit_parity"],
+        "moe_dropped_frac": cold["transformer"]["moe_dropped_frac"],
+        "compiles_cold": cold["program_compiles"],
+        "compiles_warm": warm["program_compiles"],
+        "loads_warm": warm["program_loads"],
+        "warm_tok_per_sec": warm["transformer_large_tok_per_sec"],
+    }
+
+
 def _integrity_overhead_probe(workload_step_s, period=100, steps=200,
                               pairs=3):
     """Fused-fingerprint overhead at ``period``, measured where a CPU
@@ -1100,6 +1158,24 @@ def main():
             line["program_cache"] = probe
         except Exception as e:                      # noqa: BLE001
             line["program_cache_error"] = str(e)
+
+    # --- large-model parallelism workloads (docs/how_to/perf.md
+    # "Large-model parallelism"): sparse-MoE / causal-skip-ring /
+    # interleaved-pipeline A/Bs plus the composed transformer-large
+    # and ringattn-long-context headline windows, all gated inside
+    # tools/parallel_bench.py (subprocess: the 8-device virtual mesh
+    # needs XLA_FLAGS before jax init).  ~2 min on CPU;
+    # MXTPU_BENCH_PARALLEL=0 skips.
+    if os.environ.get("MXTPU_BENCH_PARALLEL", "1") != "0":
+        try:
+            probe = _parallel_probe()
+            line["transformer_large_tok_per_sec"] = \
+                probe["transformer_large_tok_per_sec"]
+            line["ringattn_tok_per_sec"] = \
+                probe["ringattn_tok_per_sec"]
+            line["parallel"] = probe
+        except Exception as e:                      # noqa: BLE001
+            line["parallel_error"] = str(e)
 
     # --- silent-data-corruption defense (docs/how_to/resilience.md
     # "Silent data corruption"): rebuild the module with the in-step

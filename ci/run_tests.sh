@@ -217,6 +217,20 @@ stage "mem lint gate (static buffer-liveness peak-HBM analysis)"
 # trace time, docs/how_to/static_analysis.md "Memory analysis"
 python tools/mem_lint.py --check
 
+stage "large-model parallelism suite (sparse MoE / pipeline schedules / causal-skip ring / composed workloads)"
+# the perf-path parallelism layers and their composition: sparse vs
+# dense MoE dispatch value+grad parity (EXACT on integer data), top-2
+# gating vs the softmax reference, causal-skip ring attention vs the
+# reference at every (n_shards, causal) corner (skip is BITWISE vs
+# no-skip), interleaved-vs-gpipe schedule parity vs the serial stack,
+# the transformer-large kill-and-resume bit-parity drill through
+# CheckpointManager, and the dropped_frac / bubble-frac / dispatch-
+# byte-model contracts.  HARD timeout: a wedged collective in the
+# composed step must FAIL this stage, not hang the suite —
+# docs/how_to/perf.md "Large-model parallelism"
+timeout -k 10 420 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_parallel_workloads.py -q
+
 stage "runtime telemetry suite (metrics registry / spans / trace export)"
 # the unified-observability layer: registry snapshot/merge, serving
 # request + training step span trees, correlation-ID propagation
@@ -252,7 +266,7 @@ timeout -k 10 840 env JAX_PLATFORMS=cpu MXTPU_TSAN=1 MXTPU_OBS=1 \
         tests/test_stream_pipeline.py tests/test_obs.py \
         tests/test_elastic.py tests/test_integrity.py \
         tests/test_quant_calibration.py tests/test_mem_lint.py \
-        tests/test_fleet.py \
+        tests/test_fleet.py tests/test_parallel_workloads.py \
         -q -m "not slow"
 python tools/concurrency_lint.py --no-static --replay "$TSAN_LOG" --check
 rm -f "$TSAN_LOG"

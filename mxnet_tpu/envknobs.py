@@ -101,6 +101,15 @@ KNOBS: Dict[str, _Knob] = dict((
        "ModelServer construction (env and ctor args override it)"),
     _k("MXTPU_STRICT_KNOBS", "bool", False, "envknobs",
        "escalate unknown-knob warnings to MXNetError"),
+    # --- large-model parallelism ---------------------------------------
+    _k("MXTPU_MOE_DISPATCH", "str", "sparse", "parallel",
+       "MoE dispatch path: sparse (sort-based) | dense (one-hot "
+       "einsum A/B reference)"),
+    _k("MXTPU_PIPE_SCHEDULE", "str", "interleaved", "parallel",
+       "pipeline schedule: interleaved (circular placement) | gpipe "
+       "(blocked fill-drain)"),
+    _k("MXTPU_RING_SKIP", "bool", True, "parallel",
+       "causal ring attention: lax.cond-skip fully masked K/V blocks"),
     # --- input pipeline ------------------------------------------------
     _k("MXTPU_UPLOAD_OVERLAP", "bool", None, "io",
        "wrap fit() feeding in DeviceUploadIter (default: multi-core)"),
@@ -263,6 +272,11 @@ KNOBS: Dict[str, _Knob] = dict((
        "run the tune-plan A/B probe"),
     _k("MXTPU_BENCH_FLEET", "bool", True, "bench",
        "run the fleet scaling/churn/rollout probe"),
+    _k("MXTPU_BENCH_PARALLEL", "bool", True, "bench",
+       "run the parallel-workloads probe (MoE/pipeline/ring A/Bs + "
+       "composed transformer windows)"),
+    _k("MXTPU_BENCH_PARALLEL_STEPS", "int", 3, "bench",
+       "dispatches per timed window in the parallel-workloads probe"),
     _k("MXTPU_TUNE_CORPUS", "str", None, "tuneplan",
        "TUNE_CORPUS.jsonl path override (default: repo root)"),
     _k("MXTPU_CI_FULL", "bool", False, "ci", "nightly CI tier"),

@@ -11,23 +11,28 @@ one device Mesh + sharding annotations, with XLA inserting the collectives.
   * :mod:`trainer`     — the fused train step: fwd+bwd+allreduce+update in
                          ONE jitted XLA computation (BASELINE north star)
   * :mod:`ring_attention` — sequence-parallel blockwise attention over an
-                         ICI ring (long-context first-class support)
-  * :mod:`pipeline`    — GPipe-style SPMD pipeline over a ``pipe`` axis
-                         (AD derives the backward schedule)
-  * :mod:`moe`         — expert parallelism: dispatch/combine MoE over an
-                         ``expert`` axis
+                         ICI ring (fused K/V permute, causal block skip)
+  * :mod:`pipeline`    — SPMD pipeline over a ``pipe`` axis: interleaved
+                         or GPipe schedule (AD derives the backward)
+  * :mod:`moe`         — expert parallelism: sort-based sparse (or dense
+                         one-hot) dispatch MoE over an ``expert`` axis
+  * :mod:`transformer` — the composed benched workloads: transformer-large
+                         (pipeline×MoE×grad_accum×zero) and the
+                         long-context ring-attention LM
 """
 from .mesh import (Mesh, get_mesh, current_mesh, data_parallel_mesh,
                    global_data_parallel_mesh, make_mesh)
 from .collectives import global_allreduce, barrier
 from .trainer import Trainer
 from .ring_attention import ring_attention, ring_attention_sharded
-from .pipeline import pipeline_apply
-from .moe import moe_init, moe_apply, moe_shardings, moe_load_balance_loss
+from .pipeline import pipeline_apply, pipeline_bubble_frac
+from .moe import (moe_init, moe_apply, moe_shardings,
+                  moe_load_balance_loss, moe_dispatch_bytes)
 
 __all__ = ["Mesh", "get_mesh", "current_mesh", "data_parallel_mesh",
            "global_data_parallel_mesh", "make_mesh", "global_allreduce",
            "barrier", "Trainer",
            "ring_attention", "ring_attention_sharded", "pipeline_apply",
-           "moe_init", "moe_apply", "moe_shardings",
-           "moe_load_balance_loss"]
+           "pipeline_bubble_frac", "moe_init", "moe_apply",
+           "moe_shardings", "moe_load_balance_loss",
+           "moe_dispatch_bytes"]

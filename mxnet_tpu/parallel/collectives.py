@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .mesh import get_mesh, shard_map as _shard_map
+from .mesh import shard_map as _shard_map
 
 __all__ = ["global_allreduce", "barrier", "psum_over_mesh",
            "broadcast_from_rank0", "lowp_allreduce", "lowp_comm_bytes",

@@ -1,12 +1,34 @@
 """Expert parallelism: a mixture-of-experts layer over an ``expert``
 mesh axis.
 
-Greenfield relative to the reference.  The TPU-native formulation is the
-dense dispatch/combine einsum design: top-1 token-choice gating builds a
-``(tokens, experts, capacity)`` dispatch tensor; dispatch, per-expert
-FFN and combine are plain einsums with the expert dimension sharded over
-``mesh[axis]`` — XLA lowers the resharding into the all-to-all pattern
-on ICI, no hand-written collective.
+Greenfield relative to the reference.  Two dispatch formulations share
+one gating front-end and one capacity rule:
+
+* **dense** — the textbook TPU formulation: top-k token-choice gating
+  builds a ``(tokens*k, experts, capacity)`` one-hot dispatch tensor;
+  dispatch, per-expert FFN and combine are plain einsums.  Simple, but
+  the dispatch/combine einsums cost O(T·E·C·d) FLOPs and bytes for
+  what is really a gather/scatter.
+* **sparse** — sort-based dispatch: stable-argsort the routing entries
+  by expert, gather the first ``C`` entries per expert into the static
+  ``(E, C, d)`` expert buffer, and combine by gathering each entry's
+  slot back and segment-summing the ``k`` slots per token.  O(T·k·d +
+  E·C·d) bytes — :func:`moe_dispatch_bytes` is the static model, and
+  the two paths agree bitwise because the stable sort reproduces the
+  dense cumsum position-within-expert exactly.
+
+``MXTPU_MOE_DISPATCH=dense|sparse`` selects the path (A/B knob; sparse
+is the default), or pass ``dispatch=`` explicitly.
+
+**``keep`` mask contract** — ``moe_apply`` returns ``(out, keep)``.
+``keep[t]`` (top-1) or ``keep[t, j]`` (top-k) is True iff that routing
+entry landed within its expert's capacity ``C = ceil(T·k/E · factor)``;
+a False entry contributed exactly 0 to ``out`` (the token was dropped
+by that expert, standard capacity-based routing — shapes stay static
+for XLA).  Callers that care about routing health should surface the
+fraction via :func:`record_dropped_frac`, which backs the
+``parallel.moe.dropped_frac`` obs counter; the trainer-side silent
+discard of ``keep`` is exactly what that counter exists to catch.
 """
 from __future__ import annotations
 
@@ -16,8 +38,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
-__all__ = ["moe_init", "moe_apply", "moe_shardings",
-           "moe_load_balance_loss"]
+from .. import envknobs as _envknobs
+from .. import obs as _obs
+
+__all__ = ["moe_init", "moe_apply", "moe_apply_dense", "moe_apply_sparse",
+           "moe_shardings", "moe_load_balance_loss", "moe_capacity",
+           "moe_dispatch_bytes", "record_dropped_frac"]
+
+# last observed dropped-token fraction (registry-backed; scraped by
+# obs.snapshot() / tools/obs_report.py).  A fraction, set per call —
+# see record_dropped_frac.
+_DROPPED_FRAC = _obs.counter("parallel.moe.dropped_frac", initial=0.0)
 
 
 def moe_init(key, d_model, d_hidden, n_experts, dtype=jnp.float32):
@@ -44,38 +75,164 @@ def moe_shardings(mesh, axis="expert"):
     }
 
 
-def moe_apply(params, x, capacity_factor=1.25):
-    """Top-1 MoE FFN.  ``x``: (tokens, d_model) -> (tokens, d_model).
+def moe_capacity(n_tokens, n_experts, capacity_factor=1.25, top_k=1):
+    """Static per-expert capacity ``C = ceil(T·k/E · factor)``."""
+    return max(1, math.ceil((n_tokens * top_k / n_experts)
+                            * capacity_factor))
 
-    Tokens over an expert's capacity ``C = ceil(T/E * factor)`` are
-    dropped (output 0 for their FFN path) — standard capacity-based
-    routing, which keeps every shape static for XLA.
+
+def _gate_topk(params, x, top_k):
+    """Shared gating front-end: softmax gate, top-k expert choice.
+
+    Returns ``(gates, expert, gate_val)`` with ``expert``/``gate_val``
+    of shape (T, k).  Top-1 keeps the raw softmax probability (the
+    Switch convention); k>1 renormalizes the chosen probabilities to
+    sum to 1 per token.
+    """
+    gates = jax.nn.softmax(x @ params["gate"], axis=-1)
+    if top_k == 1:
+        expert = jnp.argmax(gates, axis=-1)[:, None]
+        gate_val = jnp.take_along_axis(gates, expert, 1)
+    else:
+        gate_val, expert = jax.lax.top_k(gates, top_k)
+        gate_val = gate_val / jnp.sum(gate_val, axis=-1, keepdims=True)
+    return gates, expert, gate_val
+
+
+def _expert_ffn(params, ex_in):
+    """(E, C, d) -> (E, C, d): each expert's 2-layer relu FFN."""
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", ex_in, params["w1"]))
+    return jnp.einsum("ech,ehd->ecd", h, params["w2"])
+
+
+def _finish(out_flat, keep_flat, T, top_k, d):
+    """Fold the k routing slots back per token (the segment-sum: slots
+    of one token are adjacent in entry order t·k+j)."""
+    if top_k == 1:
+        return out_flat, keep_flat
+    return (out_flat.reshape(T, top_k, d).sum(axis=1),
+            keep_flat.reshape(T, top_k))
+
+
+def moe_apply_dense(params, x, capacity_factor=1.25, top_k=1):
+    """Dense one-hot dispatch/combine (the A/B reference path).
+
+    ``x``: (tokens, d_model) -> ((tokens, d_model), keep).
     """
     T, d = x.shape
     E = params["gate"].shape[1]
-    C = max(1, math.ceil((T / E) * capacity_factor))
+    C = moe_capacity(T, E, capacity_factor, top_k)
+    _, expert, gate_val = _gate_topk(params, x, top_k)
+    ef = expert.reshape(-1)                              # (N,) N = T*k
+    gf = gate_val.reshape(-1)
 
-    logits = x @ params["gate"]                       # (T, E)
-    gates = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(gates, axis=-1)               # (T,)
-    gate_val = jnp.take_along_axis(gates, expert[:, None], 1)[:, 0]
-
-    # position of each token within its expert's queue
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)      # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot - 1            # (T, E)
-    pos_in_e = jnp.max(pos, axis=1)                          # (T,)
+    # position of each routing entry within its expert's queue
+    onehot = jax.nn.one_hot(ef, E, dtype=jnp.int32)      # (N, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1        # (N, E)
+    pos_in_e = jnp.max(pos, axis=1)                      # (N,)
     keep = pos_in_e < C
 
-    # dispatch (T, E, C) one-hot; dropped tokens vanish
-    disp = (jax.nn.one_hot(expert, E, dtype=x.dtype)[:, :, None] *
+    # dispatch (N, E, C) one-hot; dropped entries vanish
+    disp = (jax.nn.one_hot(ef, E, dtype=x.dtype)[:, :, None] *
             jax.nn.one_hot(jnp.clip(pos_in_e, 0, C - 1), C,
                            dtype=x.dtype)[:, None, :] *
             keep[:, None, None].astype(x.dtype))
-    ex_in = jnp.einsum("tec,td->ecd", disp, x)               # (E, C, d)
-    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", ex_in, params["w1"]))
-    ex_out = jnp.einsum("ech,ehd->ecd", h, params["w2"])     # (E, C, d)
-    out = jnp.einsum("tec,ecd->td", disp, ex_out)
-    return out * gate_val[:, None], keep
+    x_rep = jnp.repeat(x, top_k, axis=0) if top_k > 1 else x
+    ex_in = jnp.einsum("tec,td->ecd", disp, x_rep)       # (E, C, d)
+    ex_out = _expert_ffn(params, ex_in)                  # (E, C, d)
+    out = jnp.einsum("tec,ecd->td", disp, ex_out) * gf[:, None]
+    return _finish(out, keep, T, top_k, d)
+
+
+def moe_apply_sparse(params, x, capacity_factor=1.25, top_k=1):
+    """Sort-based dispatch: argsort entries by expert, gather the first
+    ``C`` per expert into the (E, C, d) buffer, combine by gathering
+    back.  The stable sort keeps entries of one expert in original
+    order, so position-within-expert (and therefore which tokens drop)
+    matches the dense cumsum bit-for-bit.
+    """
+    T, d = x.shape
+    E = params["gate"].shape[1]
+    C = moe_capacity(T, E, capacity_factor, top_k)
+    _, expert, gate_val = _gate_topk(params, x, top_k)
+    N = T * top_k
+    ef = expert.reshape(-1)                              # (N,)
+    gf = gate_val.reshape(-1)
+
+    order = jnp.argsort(ef, stable=True)                 # (N,) entry ids
+    counts = jnp.bincount(ef, length=E)                  # (E,)
+    start = jnp.cumsum(counts) - counts                  # exclusive cumsum
+    # in sorted order, expert e's entries sit at start[e]..+counts[e)-1
+    pos_sorted = jnp.arange(N) - start[ef[order]]
+    pos_in_e = jnp.zeros(N, pos_sorted.dtype).at[order].set(pos_sorted)
+    keep = pos_in_e < C
+
+    # dispatch: slot (e, c) takes entry order[start[e]+c] when c < counts[e]
+    slot = start[:, None] + jnp.arange(C)[None, :]       # (E, C)
+    valid = jnp.arange(C)[None, :] < counts[:, None]     # (E, C)
+    src = order[jnp.clip(slot, 0, N - 1)]                # (E, C) entry ids
+    tok = src // top_k if top_k > 1 else src             # (E, C) token ids
+    ex_in = jnp.where(valid[..., None], x[tok], jnp.zeros((), x.dtype))
+    ex_out = _expert_ffn(params, ex_in)                  # (E, C, d)
+
+    # combine: each kept entry reads its slot back; dropped entries are 0
+    gath = ex_out[ef, jnp.clip(pos_in_e, 0, C - 1)]      # (N, d)
+    out = jnp.where(keep[:, None], gath,
+                    jnp.zeros((), gath.dtype)) * gf[:, None]
+    return _finish(out, keep, T, top_k, d)
+
+
+def moe_apply(params, x, capacity_factor=1.25, top_k=1, dispatch=None):
+    """Top-k MoE FFN.  ``x``: (tokens, d_model) -> (tokens, d_model).
+
+    ``dispatch``: "dense" | "sparse" | None (None resolves the
+    ``MXTPU_MOE_DISPATCH`` knob, default "sparse").  Both paths agree
+    on values, grads, and the ``keep`` mask (see module docstring for
+    the mask contract); tokens over an expert's capacity are dropped.
+    """
+    if dispatch is None:
+        dispatch = _envknobs.get_str("MXTPU_MOE_DISPATCH", "sparse")
+    if dispatch not in ("dense", "sparse"):
+        raise ValueError("MXTPU_MOE_DISPATCH=%r (want dense|sparse)"
+                         % (dispatch,))
+    fn = moe_apply_dense if dispatch == "dense" else moe_apply_sparse
+    return fn(params, x, capacity_factor=capacity_factor, top_k=top_k)
+
+
+def record_dropped_frac(keep):
+    """Host-side: record ``1 - mean(keep)`` on the registry-backed
+    ``parallel.moe.dropped_frac`` counter and return it.  Call OUTSIDE
+    jit with the concrete ``keep`` mask from :func:`moe_apply` — this
+    is the observable that makes silent capacity drops visible."""
+    frac = float(1.0 - jnp.mean(jnp.asarray(keep, jnp.float32)))
+    _DROPPED_FRAC.set(frac)
+    return frac
+
+
+def moe_dispatch_bytes(n_tokens, d_model, n_experts,
+                       capacity_factor=1.25, top_k=1, dispatch="sparse",
+                       itemsize=4):
+    """Static dispatch+combine traffic model (bytes, excluding the
+    expert FFN itself, which is identical in both paths).
+
+    dense: the (N, E, C) dispatch tensor is written once and read by
+    both einsums, which also stream x/ex_in/ex_out/out.
+    sparse: index arrays (int32) plus two gathers — no (N, E, C)
+    tensor ever exists.  bench.py gates sparse <= dense/2 on the
+    transformer-large shape.
+    """
+    T, d, E = int(n_tokens), int(d_model), int(n_experts)
+    C = moe_capacity(T, E, capacity_factor, top_k)
+    N = T * top_k
+    if dispatch == "dense":
+        return itemsize * (3 * N * E * C      # disp: 1 write + 2 reads
+                           + 2 * N * d        # x read, out write
+                           + 2 * E * C * d)   # ex_in write, ex_out read
+    if dispatch == "sparse":
+        return (itemsize * (2 * E * C * d     # gather write, ex_out read
+                            + 3 * N * d)      # x read, gath, out write
+                + 4 * (2 * N + 2 * E + 2 * E * C))  # int32 index arrays
+    raise ValueError("dispatch=%r (want dense|sparse)" % (dispatch,))
 
 
 def moe_load_balance_loss(params, x, gates=None):

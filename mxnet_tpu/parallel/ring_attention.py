@@ -9,6 +9,21 @@ the softmax online (flash-attention style running max/denominator), so
 peak memory is O(T/N) and the K/V transfer rides one ICI hop per step,
 overlapped by XLA with the local block matmul.
 
+Two hot-path optimizations over the textbook loop:
+
+* **fused K/V permute** — K and V travel as ONE stacked ``(2, ...)``
+  array, one ``ppermute`` per step instead of two; and the own block is
+  consumed before the loop, so a full sweep launches ``n-1`` collectives
+  (down from ``2n``).
+* **causal block skip** — under ``causal=True`` a rotated block is fully
+  masked iff ``blk_idx > my_idx`` (every key position is ahead of every
+  query position), which is ~half of all (device, step) pairs.  A fully
+  masked block is an exact no-op on the online-softmax state (p=0,
+  m_new=m, corr=1), so ``lax.cond``-skipping it is bit-identical while
+  dropping the einsum work.  The permute stays OUTSIDE the cond — every
+  device runs the same collective sequence.  ``MXTPU_RING_SKIP=0`` (or
+  ``skip_masked=False``) keeps the compute for A/B timing.
+
 ``ring_attention`` is the per-shard computation (call under ``shard_map``);
 ``ring_attention_sharded`` wraps a global array end-to-end.
 """
@@ -20,18 +35,24 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec
 
+from .. import envknobs as _envknobs
 from .mesh import shard_map as _shard_map
 
 __all__ = ["ring_attention", "ring_attention_sharded", "attention_reference"]
 
 
-def ring_attention(q, k, v, axis_name="seq", causal=False, scale=None):
+def ring_attention(q, k, v, axis_name="seq", causal=False, scale=None,
+                   skip_masked=None):
     """Blockwise attention over a ring.
 
     Args: ``q, k, v`` local shards of shape ``[batch, t_local, heads, dim]``
     inside a ``shard_map`` over ``axis_name``.  Returns the local output
-    shard ``[batch, t_local, heads, dim]``.
+    shard ``[batch, t_local, heads, dim]``.  ``skip_masked``: None
+    resolves ``MXTPU_RING_SKIP`` (default on; only relevant under
+    ``causal``).
     """
+    if skip_masked is None:
+        skip_masked = _envknobs.get_bool("MXTPU_RING_SKIP", True)
     n_shards = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, t, h, d = q.shape
@@ -41,10 +62,11 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, scale=None):
 
     perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
 
-    def body(i, carry):
-        o, m, l, k_blk, v_blk = carry
-        # after i rotations we hold the block originally on (my_idx - i)
-        blk_idx = (my_idx - i) % n_shards
+    def accumulate(carry, kv_blk, blk_idx):
+        # pure online-softmax update for one K/V block — no collectives
+        # (it runs inside lax.cond when the causal skip is on)
+        o, m, l = carry
+        k_blk, v_blk = kv_blk[0], kv_blk[1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q32, k_blk.astype(jnp.float32))
         if causal:
             q_pos = my_idx * t + jnp.arange(t)
@@ -62,26 +84,49 @@ def ring_attention(q, k, v, axis_name="seq", causal=False, scale=None):
         o_new = (o * corr[..., None]
                  + jnp.einsum("bhqk,bkhd->bhqd", p,
                               v_blk.astype(jnp.float32)))
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return o_new, m_new, l_new, k_next, v_next
+        return o_new, m_new, l_new
 
     o0 = jnp.zeros((b, h, t, d), jnp.float32)
     m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, h, t), jnp.float32)
-    o, m, l, _, _ = jax.lax.fori_loop(0, n_shards, body, (o0, m0, l0, k, v))
+
+    # K and V ride one stacked carry so each ring step is ONE ppermute
+    kv0 = jnp.stack([k, v])                          # (2, b, t, h, d)
+    # own block first (never fully masked under causal: the diagonal),
+    # so the loop below is pure permute-then-compute — n-1 hops total
+    carry0 = accumulate((o0, m0, l0), kv0, my_idx)
+
+    def body(i, state):
+        carry, kv_blk = state
+        kv_blk = jax.lax.ppermute(kv_blk, axis_name, perm)
+        # after i rotations we hold the block originally on (my_idx - i)
+        blk_idx = (my_idx - i) % n_shards
+        if causal and skip_masked:
+            # fully masked iff the whole block is in the future; the
+            # update is an exact no-op there, so skip its FLOPs
+            carry = jax.lax.cond(
+                blk_idx > my_idx,
+                lambda c: c,
+                lambda c: accumulate(c, kv_blk, blk_idx),
+                carry)
+        else:
+            carry = accumulate(carry, kv_blk, blk_idx)
+        return carry, kv_blk
+
+    (o, m, l), _ = jax.lax.fori_loop(1, n_shards, body, (carry0, kv0))
     l = jnp.where(l == 0.0, 1.0, l)
     out = o / l[..., None]
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
 def ring_attention_sharded(q, k, v, mesh, axis="seq", causal=False,
-                           scale=None):
+                           scale=None, skip_masked=None):
     """Apply ring attention to globally-shaped ``[b, t, h, d]`` arrays
     sharded (or shardable) over ``mesh[axis]`` on the time dimension."""
     spec = PartitionSpec(None, axis, None, None)
     fn = _shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal, scale=scale),
+        partial(ring_attention, axis_name=axis, causal=causal, scale=scale,
+                skip_masked=skip_masked),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

@@ -13,10 +13,12 @@ through, and runs the comm rules over each plan
   * ``serving-forward`` — the serving eval program (no collectives on a
     replicated single-host mesh: the baseline records an EMPTY plan, so
     a collective showing up here is loud).
-  * ``ring-attention`` — the sequence-parallel ring (``ppermute`` per
-    rotation, trip-counted through the inner loop).
-  * ``pipeline`` — the GPipe-style SPMD pipeline (stage-hop
-    ``ppermute`` inside the tick scan, the closing ``psum``).
+  * ``ring-attention`` — the sequence-parallel ring (ONE fused K/V
+    ``ppermute`` per rotation, n-1 rotations, trip-counted through the
+    inner loop).
+  * ``pipeline`` — the SPMD pipeline on the interleaved v=2 schedule
+    (stage-hop ``ppermute`` inside the tick scan; the output collect is
+    a select + the same hop — no closing ``psum``).
   * ``comm-source`` — the ``rank-divergent-collective`` AST rule over
     ``mxnet_tpu/`` (rank-conditioned control flow guarding collective
     calls — the classic multi-host wedge).
@@ -122,7 +124,7 @@ def pipeline_target():
     from mxnet_tpu.parallel import make_mesh, pipeline_apply
 
     mesh = make_mesh({"pipe": min(2, len(jax.devices()))}, jax.devices())
-    S = mesh.shape["pipe"]
+    S = 2 * mesh.shape["pipe"]       # v=2 stages/device: interleaved
     d = 16
     params = {"w": jax.ShapeDtypeStruct((S, d, d), np.float32)}
 
@@ -131,7 +133,8 @@ def pipeline_target():
 
     def prog(params, xs):
         with jax.named_scope("pipe_apply"):
-            return pipeline_apply(stage, params, xs, mesh)
+            return pipeline_apply(stage, params, xs, mesh,
+                                  schedule="interleaved")
 
     xs = jax.ShapeDtypeStruct((4, 8, d), np.float32)
     jaxpr = jax.make_jaxpr(prog)(params, xs)

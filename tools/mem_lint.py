@@ -14,8 +14,14 @@ each program:
     (replicated weights, row-sharded batch).
   * ``ring-attention`` — the sequence-parallel ring (block-local
     shard_map bodies priced at face value).
-  * ``pipeline`` — the GPipe-style SPMD pipeline (stage-hop scan:
-    body temporaries counted once, stacked outputs at call level).
+  * ``pipeline`` — the SPMD pipeline on the interleaved v=2 schedule
+    (stage-hop scan: body temporaries counted once, stacked outputs at
+    call level).
+  * ``transformer-large`` — the composed bench workload's full train
+    step (pipeline x MoE x grad-accum x ZeRO momentum) at the exact
+    ``transformer_large()`` config bench.py times.
+  * ``ringattn-long-context`` — the long-context causal ring-attention
+    LM forward at the exact ``ringattn_long_context()`` config.
 
 Rules: ``mem-budget`` (predicted-GB ratchet vs ``MEM_BASELINE.json``),
 ``mem-capacity`` (peak vs ``MXTPU_HBM_BYTES`` / detected device memory
@@ -131,7 +137,7 @@ def pipeline_target():
     from mxnet_tpu.parallel import make_mesh, pipeline_apply
 
     mesh = make_mesh({"pipe": min(2, len(jax.devices()))}, jax.devices())
-    S = mesh.shape["pipe"]
+    S = 2 * mesh.shape["pipe"]       # v=2 stages/device: interleaved
     d = 16
     params = {"w": jax.ShapeDtypeStruct((S, d, d), np.float32)}
 
@@ -140,10 +146,66 @@ def pipeline_target():
 
     def prog(params, xs):
         with jax.named_scope("pipe_apply"):
-            return pipeline_apply(stage, params, xs, mesh)
+            return pipeline_apply(stage, params, xs, mesh,
+                                  schedule="interleaved")
 
     xs = jax.ShapeDtypeStruct((4, 8, d), np.float32)
     jaxpr = jax.make_jaxpr(prog)(params, xs)
+    return jaxpr, {"axis_sizes": dict(mesh.shape), "is_train": False}
+
+
+def _abstract(tree):
+    import jax
+    return jax.tree.map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def transformer_large_target():
+    """The composed transformer-large train step, traced abstractly at
+    the SAME config bench.py's parallel probe times — the peak-HBM
+    ratchet for the headline workload (needs the 8-device mesh)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel import transformer as tfm
+
+    cfg = tfm.transformer_large()
+    mesh = make_mesh({"pipe": cfg.pipe}, jax.devices())
+    params = _abstract(tfm.transformer_init(jax.random.PRNGKey(0), cfg))
+    mom = jax.tree.map(lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+                       params)
+    step = tfm.make_train_step(cfg, mesh, params_template=params)
+    toks = jax.ShapeDtypeStruct(
+        (cfg.grad_accum, cfg.n_micro, cfg.microbatch, cfg.seq),
+        np.int32)
+
+    def prog(params, mom, toks):
+        with jax.named_scope("transformer_large_step"):
+            return step(params, mom, toks)
+
+    jaxpr = jax.make_jaxpr(prog)(params, mom, toks)
+    return jaxpr, {"axis_sizes": dict(mesh.shape), "is_train": True}
+
+
+def ringattn_long_context_target():
+    """The long-context ring-attention LM forward at the bench config
+    (needs the 8-device mesh for the seq axis)."""
+    import jax
+    import numpy as np
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel import transformer as tfm
+
+    cfg = tfm.ringattn_long_context()
+    mesh = make_mesh({"seq": cfg.seq_shards}, jax.devices())
+    params = _abstract(tfm.ringattn_init(jax.random.PRNGKey(0), cfg))
+    toks = jax.ShapeDtypeStruct((cfg.microbatch, cfg.seq), np.int32)
+
+    def prog(params, toks):
+        with jax.named_scope("ringattn_forward"):
+            return tfm.ringattn_forward(params, toks, cfg, mesh)
+
+    jaxpr = jax.make_jaxpr(prog)(params, toks)
     return jaxpr, {"axis_sizes": dict(mesh.shape), "is_train": False}
 
 
@@ -151,7 +213,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("targets", nargs="*",
                     help="targets to analyze (default: trainer-step, "
-                         "serving-forward, ring-attention, pipeline)")
+                         "serving-forward, ring-attention, pipeline, "
+                         "transformer-large, ringattn-long-context)")
     ap.add_argument("--live", action="store_true",
                     help="print the full liveness top-10 per target "
                          "(default: top 3)")
@@ -174,14 +237,16 @@ def main(argv=None):
                     help=argparse.SUPPRESS)  # gate-failure test hook
     args = ap.parse_args(argv)
 
-    # trace-time only: keep the gate off the chip, on two virtual host
-    # devices so the mesh targets get real >1 axes (graph_lint pattern)
+    # trace-time only: keep the gate off the chip, on EIGHT virtual
+    # host devices so the composed bench-config targets trace at their
+    # real pipe/seq axis sizes (the 2-axis targets still take
+    # min(2, ...) and are unchanged)
     if "MXTPU_LINT_PLATFORM" not in os.environ:
         if "xla_force_host_platform_device_count" not in \
                 os.environ.get("XLA_FLAGS", ""):
             os.environ["XLA_FLAGS"] = (
                 os.environ.get("XLA_FLAGS", "")
-                + " --xla_force_host_platform_device_count=2")
+                + " --xla_force_host_platform_device_count=8")
         import jax
         jax.config.update("jax_platforms", "cpu")
 
@@ -189,7 +254,8 @@ def main(argv=None):
     from mxnet_tpu.analysis import mem_passes
 
     all_targets = ["trainer-step", "serving-forward", "ring-attention",
-                   "pipeline"]
+                   "pipeline", "transformer-large",
+                   "ringattn-long-context"]
     names = args.targets or all_targets
     unknown = sorted(set(names) - set(all_targets))
     if unknown:
@@ -210,6 +276,10 @@ def main(argv=None):
             jaxpr, cfg = serving_forward_target(trainer)
         elif name == "ring-attention":
             jaxpr, cfg = ring_attention_target()
+        elif name == "transformer-large":
+            jaxpr, cfg = transformer_large_target()
+        elif name == "ringattn-long-context":
+            jaxpr, cfg = ringattn_long_context_target()
         else:
             jaxpr, cfg = pipeline_target()
         entry = baseline.get(name) or {}

@@ -1,0 +1,391 @@
+#!/usr/bin/env python
+"""Benchmark + gate the large-model parallelism layers on the virtual
+8-device CPU mesh (the bench.py "parallel workloads" probe; run it
+directly for development).
+
+Sections (``--only`` selects a subset):
+
+* ``moe``         — sparse (sort-based) vs dense (one-hot einsum)
+                    dispatch: static dispatch+combine bytes model
+                    (gate: sparse <= dense/2) and a timed fwd+bwd A/B
+                    (gate: sparse no worse than dense).
+* ``ring``        — causal ring attention, block-skip on vs off on the
+                    long-context shape (gate: skip >= 1.3x) — the skip
+                    is bit-identical, only faster.
+* ``pipeline``    — interleaved vs gpipe schedule at v=2 stages/device
+                    (gate: interleaved no worse; reports the static
+                    ``pipeline_bubble_frac`` for both).
+* ``transformer`` — the composed transformer-large config (pipeline ×
+                    MoE × grad_accum × zero) trained through a
+                    CompiledProgram: ``transformer_large_tok_per_sec``
+                    headline, zero-retrace gate, kill-and-resume
+                    bit-parity drill through CheckpointManager.
+* ``ringattn``    — the long-context ring-attention LM forward:
+                    ``ringattn_tok_per_sec`` headline + zero-retrace.
+
+Every timed window appends a TUNE_CORPUS.jsonl row.  With
+``MXTPU_PROGRAM_CACHE`` armed the CompiledProgram sections persist
+their executables; ``--expect warm`` additionally GATES on zero
+compiles (the warm-restart acceptance; bench.py runs cold then warm).
+
+Prints one ``PARALLEL_BENCH {json}`` line; exits non-zero on any gate
+failure.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+# the virtual mesh must exist before jax initializes
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class GateError(RuntimeError):
+    """A perf/correctness acceptance gate failed."""
+
+
+def _window(fn, args, steps, repeats=3):
+    """Median wall seconds per step: ``steps`` dispatches per window,
+    block on the last output, median of ``repeats`` windows (warm
+    call first)."""
+    import jax
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - t0) / steps)
+    return sorted(times)[len(times) // 2]
+
+
+def _corpus(config, measured):
+    from mxnet_tpu import tuneplan
+    tuneplan.append_corpus({"kind": "parallel", "tool": "parallel_bench",
+                            "config": config, "measured": measured})
+
+
+def bench_moe(steps):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import moe
+
+    T, d, E, h, top_k = 2048, 256, 8, 512, 2
+    out = {"tokens": T, "d_model": d, "n_experts": E, "top_k": top_k}
+
+    dense_b = moe.moe_dispatch_bytes(T, d, E, top_k=top_k,
+                                     dispatch="dense")
+    sparse_b = moe.moe_dispatch_bytes(T, d, E, top_k=top_k,
+                                      dispatch="sparse")
+    out["dense_dispatch_mb"] = round(dense_b / 1e6, 2)
+    out["sparse_dispatch_mb"] = round(sparse_b / 1e6, 2)
+    out["dispatch_bytes_ratio"] = round(dense_b / sparse_b, 2)
+    if dense_b < 2 * sparse_b:
+        raise GateError(
+            "moe dispatch bytes gate: dense %.1fMB < 2x sparse %.1fMB"
+            % (dense_b / 1e6, sparse_b / 1e6))
+
+    key = jax.random.PRNGKey(0)
+    params = moe.moe_init(key, d, h, E)
+    x = jax.random.normal(jax.random.PRNGKey(1), (T, d))
+
+    def make(dispatch):
+        def fwd_bwd(p, x):
+            def loss(p):
+                out, _ = moe.moe_apply(p, x, top_k=top_k,
+                                       dispatch=dispatch)
+                return (out * out).sum()
+            return jax.grad(loss)(p)
+        return jax.jit(fwd_bwd)
+
+    dense_fn, sparse_fn = make("dense"), make("sparse")
+    # parity before timing: same routing, same math
+    gd, gs = dense_fn(params, x), sparse_fn(params, x)
+    err = max(float(jnp.max(jnp.abs(gd[k] - gs[k]))) for k in gd)
+    out["grad_parity_err"] = err
+    if err > 1e-5:
+        raise GateError("moe sparse/dense grad parity: %.2e" % err)
+
+    for name, fn in (("dense", dense_fn), ("sparse", sparse_fn)):
+        dt = _window(fn, (params, x), steps)
+        out["%s_ms" % name] = round(dt * 1e3, 3)
+        _corpus({"workload": "moe-fwd-bwd", "dispatch": name,
+                 "tokens": T, "d_model": d, "n_experts": E,
+                 "top_k": top_k},
+                {"ms_per_step": out["%s_ms" % name]})
+    out["sparse_speedup"] = round(out["dense_ms"] / out["sparse_ms"], 2)
+    if out["sparse_ms"] > out["dense_ms"] * 1.05:
+        raise GateError(
+            "moe timed gate: sparse %.2fms worse than dense %.2fms"
+            % (out["sparse_ms"], out["dense_ms"]))
+    return out
+
+
+def bench_ring(steps):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention_sharded
+
+    b, t, hh, dh, shards = 1, 4096, 8, 64, 8
+    mesh = make_mesh({"seq": shards})
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (b, t, hh, dh))
+    k = jax.random.normal(kk, (b, t, hh, dh))
+    v = jax.random.normal(kv, (b, t, hh, dh))
+    out = {"seq": t, "heads": hh, "head_dim": dh, "shards": shards}
+
+    def make(skip):
+        return jax.jit(lambda q, k, v: ring_attention_sharded(
+            q, k, v, mesh, causal=True, skip_masked=skip))
+
+    skip_fn, noskip_fn = make(True), make(False)
+    err = float(jnp.max(jnp.abs(skip_fn(q, k, v) - noskip_fn(q, k, v))))
+    out["skip_parity_err"] = err
+    if err != 0.0:
+        raise GateError("causal skip is not bit-identical: %.2e" % err)
+
+    for name, fn in (("noskip", noskip_fn), ("skip", skip_fn)):
+        dt = _window(fn, (q, k, v), steps)
+        out["%s_ms" % name] = round(dt * 1e3, 3)
+        _corpus({"workload": "ring-attention", "seq": t, "heads": hh,
+                 "head_dim": dh, "shards": shards, "causal": True,
+                 "skip_masked": name == "skip"},
+                {"ms_per_step": out["%s_ms" % name]})
+    out["skip_speedup"] = round(out["noskip_ms"] / out["skip_ms"], 2)
+    if out["skip_speedup"] < 1.3:
+        raise GateError(
+            "ring causal-skip gate: %.2fx < 1.3x (skip %.1fms, "
+            "no-skip %.1fms)" % (out["skip_speedup"], out["skip_ms"],
+                                 out["noskip_ms"]))
+    return out
+
+
+def bench_pipeline(steps):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.pipeline import (pipeline_apply,
+                                             pipeline_bubble_frac)
+
+    n, v, M, mb, d, h = 4, 2, 8, 16, 256, 512
+    S = n * v
+    mesh = make_mesh({"pipe": n})
+    rng = np.random.RandomState(0)
+    W1 = jnp.asarray(rng.normal(0, d ** -0.5, (S, d, h)), jnp.float32)
+    W2 = jnp.asarray(rng.normal(0, h ** -0.5, (S, h, d)), jnp.float32)
+    xs = jnp.asarray(rng.normal(0, 1, (M, mb, d)), jnp.float32)
+
+    def stage(p, x):
+        return jnp.tanh(x @ p[0]) @ p[1]
+
+    out = {"devices": n, "stages": S, "n_micro": M}
+    for sched in ("gpipe", "interleaved"):
+        out["%s_bubble_frac" % sched] = round(
+            pipeline_bubble_frac(n, M, v, sched), 4)
+
+        def loss(params, xs, sched=sched):
+            return (pipeline_apply(stage, params, xs, mesh,
+                                   schedule=sched) ** 2).sum()
+
+        fn = jax.jit(jax.grad(loss))
+        dt = _window(fn, ((W1, W2), xs), steps)
+        out["%s_ms" % sched] = round(dt * 1e3, 3)
+        _corpus({"workload": "pipeline-fwd-bwd", "schedule": sched,
+                 "devices": n, "stages": S, "n_micro": M,
+                 "microbatch": mb, "d_model": d},
+                {"ms_per_step": out["%s_ms" % sched],
+                 "bubble_frac": out["%s_bubble_frac" % sched]})
+    out["interleaved_speedup"] = round(
+        out["gpipe_ms"] / out["interleaved_ms"], 2)
+    if out["interleaved_ms"] > out["gpipe_ms"] * 1.05:
+        raise GateError(
+            "pipeline schedule gate: interleaved %.2fms worse than "
+            "gpipe %.2fms" % (out["interleaved_ms"], out["gpipe_ms"]))
+    return out
+
+
+def bench_transformer(steps):
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import program, resilience
+    from mxnet_tpu.parallel import transformer as tfm
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.moe import record_dropped_frac, moe_apply
+
+    cfg = tfm.transformer_large()
+    mesh = make_mesh({"pipe": cfg.pipe})
+    params = tfm.transformer_init(jax.random.PRNGKey(cfg.seed), cfg)
+    mom = jax.tree.map(jnp.zeros_like, params)
+    step_fn = tfm.make_train_step(cfg, mesh, params_template=params)
+    prog = program.CompiledProgram(
+        "parallel.transformer_step", step_fn,
+        key={"workload": "transformer-large", "config": cfg.key(),
+             "mesh": {"pipe": cfg.pipe}})
+
+    toks0 = tfm.synth_tokens(cfg, 0)
+    prog.aot(params, mom, toks0)
+
+    out = {"config": cfg.key()}
+
+    # observable routing health: one eager gating pass on the first
+    # group's tokens feeds the parallel.moe.dropped_frac counter
+    x0 = (params["embed"][toks0[0]]
+          + params["pos"][None, None]).reshape(-1, cfg.d_model)
+    moe_p = jax.tree.map(lambda a: a[0], params["stages"])
+    _, keep = moe_apply({"gate": moe_p["gate"], "w1": moe_p["w1"],
+                         "w2": moe_p["w2"]}, x0,
+                        capacity_factor=cfg.capacity_factor,
+                        top_k=cfg.top_k)
+    out["moe_dropped_frac"] = round(record_dropped_frac(keep), 4)
+
+    state = {"p": params, "m": mom, "s": 0}
+
+    def run_step(_):
+        p, m = prog(state["p"], state["m"],
+                    tfm.synth_tokens(cfg, state["s"]))
+        state.update(p=p, m=m, s=state["s"] + 1)
+        return p["head"]
+
+    dt = _window(run_step, (None,), steps, repeats=3)
+    tok_s = tfm.tokens_per_step(cfg) / dt
+    out["step_ms"] = round(dt * 1e3, 2)
+    out["tok_per_sec"] = round(tok_s, 1)
+    counts = prog.counts()
+    out["retraces"] = counts["retraces"]
+    if counts["retraces"]:
+        raise GateError("transformer-large retraced %d times: %s"
+                        % (counts["retraces"], counts["lazy"]))
+
+    # kill-and-resume bit parity through CheckpointManager: straight
+    # 2K-step run vs K steps -> save -> restore-from-disk -> K steps
+    K = 3
+    pa, ma = params, mom
+    for s in range(2 * K):
+        pa, ma = prog(pa, ma, tfm.synth_tokens(cfg, s))
+    pb, mb_ = params, mom
+    for s in range(K):
+        pb, mb_ = prog(pb, mb_, tfm.synth_tokens(cfg, s))
+    ckdir = tempfile.mkdtemp(prefix="mxtpu-parallel-ck-")
+    try:
+        mgr = resilience.CheckpointManager(os.path.join(ckdir, "ck"))
+        tfm.save_composed(mgr, pb, mb_, K)
+        pr, mr, sr = tfm.load_composed(mgr.latest(), params, mom)
+        for s in range(sr, 2 * K):
+            pr, mr = prog(pr, mr, tfm.synth_tokens(cfg, s))
+    finally:
+        shutil.rmtree(ckdir, ignore_errors=True)
+    bit = all(bool(jnp.array_equal(a, b)) for a, b in
+              zip(jax.tree.leaves(pa), jax.tree.leaves(pr)))
+    out["resume_bit_parity"] = bool(bit)
+    if not bit:
+        raise GateError("transformer-large kill-and-resume diverged "
+                        "from the uninterrupted run")
+
+    _corpus({"workload": "transformer-large", **cfg.key()},
+            {"tok_per_sec": out["tok_per_sec"],
+             "step_ms": out["step_ms"],
+             "moe_dropped_frac": out["moe_dropped_frac"]})
+    return out
+
+
+def bench_ringattn(steps):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import program
+    from mxnet_tpu.parallel import transformer as tfm
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    cfg = tfm.ringattn_long_context()
+    mesh = make_mesh({"seq": cfg.seq_shards})
+    params = tfm.ringattn_init(jax.random.PRNGKey(cfg.seed), cfg)
+    toks = jax.random.randint(jax.random.PRNGKey(1),
+                              (cfg.microbatch, cfg.seq), 0, cfg.vocab,
+                              dtype=jnp.int32)
+    prog = program.CompiledProgram(
+        "parallel.ringattn_forward",
+        lambda p, t: tfm.ringattn_forward(p, t, cfg, mesh),
+        key={"workload": "ringattn-long-context", "config": cfg.key(),
+             "mesh": {"seq": cfg.seq_shards}})
+    prog.aot(params, toks)
+
+    dt = _window(prog, (params, toks), steps)
+    out = {"config": cfg.key(),
+           "step_ms": round(dt * 1e3, 2),
+           "tok_per_sec": round(cfg.microbatch * cfg.seq / dt, 1)}
+    counts = prog.counts()
+    out["retraces"] = counts["retraces"]
+    if counts["retraces"]:
+        raise GateError("ringattn retraced %d times: %s"
+                        % (counts["retraces"], counts["lazy"]))
+    _corpus({"workload": "ringattn-long-context", **cfg.key()},
+            {"tok_per_sec": out["tok_per_sec"],
+             "step_ms": out["step_ms"]})
+    return out
+
+
+SECTIONS = {"moe": bench_moe, "ring": bench_ring,
+            "pipeline": bench_pipeline, "transformer": bench_transformer,
+            "ringattn": bench_ringattn}
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--only", default=None,
+                    help="comma-separated sections (%s)"
+                    % ",".join(SECTIONS))
+    ap.add_argument("--steps", type=int, default=4,
+                    help="dispatches per timed window")
+    ap.add_argument("--expect", choices=("cold", "warm"), default="cold",
+                    help="warm: gate on zero compiles against a "
+                    "populated MXTPU_PROGRAM_CACHE")
+    args = ap.parse_args(argv)
+
+    names = (args.only.split(",") if args.only else list(SECTIONS))
+    bad = [n for n in names if n not in SECTIONS]
+    if bad:
+        ap.error("unknown sections: %s" % bad)
+
+    from mxnet_tpu import program
+    line = {"sections": names, "steps": args.steps}
+    rc = 0
+    with program.stats_delta() as delta:
+        for name in names:
+            try:
+                line[name] = SECTIONS[name](args.steps)
+            except GateError as e:
+                line[name + "_gate_error"] = str(e)
+                rc = 1
+    line["program_compiles"] = delta.get("compiles", 0)
+    line["program_loads"] = delta.get("loads", 0)
+    if args.expect == "warm" and line["program_compiles"]:
+        line["warm_gate_error"] = (
+            "warm re-run compiled %d programs (want 0; loads=%d)"
+            % (line["program_compiles"], line["program_loads"]))
+        rc = 1
+    if "transformer" in names and "transformer" in line:
+        line["transformer_large_tok_per_sec"] = \
+            line["transformer"]["tok_per_sec"]
+    if "ringattn" in names and "ringattn" in line:
+        line["ringattn_tok_per_sec"] = line["ringattn"]["tok_per_sec"]
+    print("PARALLEL_BENCH " + json.dumps(line))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
